@@ -1,0 +1,150 @@
+"""Background worker runtime.
+
+Mirrors reference src/util/background/ (mod.rs:16, worker.rs:41-59): workers
+implement `work()` (one unit, returns its next state) and `wait_for_work()`
+(sleep until something to do); a supervisor tracks per-worker state, last
+error, and consecutive-error count, applying exponential backoff after
+failures (worker.rs:188-232).  `BgVars` are runtime-tunable knobs exposed via
+the `worker set`/`worker get` CLI (src/util/background/vars.rs).
+
+asyncio-native: each worker is a task; the runner owns cancellation with an
+exit deadline (reference worker.rs:19 — 8 s).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import logging
+import traceback
+from typing import Any, Callable
+
+logger = logging.getLogger("garage.background")
+
+EXIT_DEADLINE_SEC = 8.0
+
+
+class WorkerState(enum.Enum):
+    BUSY = "busy"  # did work, call work() again immediately
+    THROTTLED = "throttled"  # busy but wait a given delay (value set aside)
+    IDLE = "idle"  # call wait_for_work()
+    DONE = "done"  # worker finished, exit
+
+
+class Worker:
+    """Subclass and override name/work/wait_for_work."""
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def status(self) -> dict[str, Any]:
+        """Freeform progress info for `worker info` (reference WorkerStatus)."""
+        return {}
+
+    async def work(self) -> WorkerState | tuple[WorkerState, float]:
+        """Do one unit of work.  Return THROTTLED with a delay as
+        (WorkerState.THROTTLED, seconds) to self-throttle."""
+        raise NotImplementedError
+
+    async def wait_for_work(self) -> None:
+        """Sleep until there may be work; default polls every second."""
+        await asyncio.sleep(1.0)
+
+
+class WorkerInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.state: str = "idle"
+        self.errors = 0
+        self.consecutive_errors = 0
+        self.last_error: str | None = None
+        self.tranquility: int | None = None
+        self.progress: dict[str, Any] = {}
+
+
+class BackgroundRunner:
+    """Spawns and supervises workers (reference src/util/background/mod.rs)."""
+
+    def __init__(self) -> None:
+        self.workers: dict[int, tuple[Worker, WorkerInfo, asyncio.Task]] = {}
+        self._next_id = 1
+        self._stopping = False
+
+    def spawn(self, worker: Worker) -> int:
+        wid = self._next_id
+        self._next_id += 1
+        info = WorkerInfo(worker.name())
+        task = asyncio.create_task(self._run_worker(worker, info), name=worker.name())
+        self.workers[wid] = (worker, info, task)
+        return wid
+
+    async def _run_worker(self, worker: Worker, info: WorkerInfo) -> None:
+        while not self._stopping:
+            try:
+                res = await worker.work()
+                info.consecutive_errors = 0
+                if isinstance(res, tuple):
+                    state, delay = res
+                else:
+                    state, delay = res, 0.0
+                info.state = state.value
+                info.progress = worker.status()
+                if state == WorkerState.DONE:
+                    return
+                if state == WorkerState.THROTTLED and delay > 0:
+                    await asyncio.sleep(delay)
+                elif state == WorkerState.IDLE:
+                    try:
+                        await asyncio.wait_for(worker.wait_for_work(), timeout=30.0)
+                    except asyncio.TimeoutError:
+                        pass
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — supervisor must survive
+                info.errors += 1
+                info.consecutive_errors += 1
+                info.last_error = f"{e!r}"
+                logger.warning(
+                    "worker %s error: %s\n%s", info.name, e, traceback.format_exc()
+                )
+                # exponential backoff, capped (reference worker.rs:188-232)
+                await asyncio.sleep(min(60.0, 2.0 ** min(info.consecutive_errors, 6)))
+
+    def worker_info(self) -> dict[int, WorkerInfo]:
+        return {wid: info for wid, (_w, info, _t) in self.workers.items()}
+
+    async def shutdown(self) -> None:
+        self._stopping = True
+        tasks = [t for (_w, _i, t) in self.workers.values()]
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            done, pending = await asyncio.wait(tasks, timeout=EXIT_DEADLINE_SEC)
+            for t in pending:
+                logger.warning("worker %s did not exit before deadline", t.get_name())
+
+
+class BgVars:
+    """Runtime-mutable named variables with getter/setter hooks
+    (reference src/util/background/vars.rs)."""
+
+    def __init__(self) -> None:
+        self._vars: dict[str, tuple[Callable[[], str], Callable[[str], None]]] = {}
+
+    def register_rw(
+        self, name: str, get: Callable[[], str], set_: Callable[[str], None]
+    ) -> None:
+        self._vars[name] = (get, set_)
+
+    def get(self, name: str) -> str:
+        if name not in self._vars:
+            raise KeyError(f"unknown variable {name!r}")
+        return self._vars[name][0]()
+
+    def set(self, name: str, value: str) -> None:
+        if name not in self._vars:
+            raise KeyError(f"unknown variable {name!r}")
+        self._vars[name][1](value)
+
+    def all(self) -> dict[str, str]:
+        return {k: g() for k, (g, _s) in sorted(self._vars.items())}

@@ -1,0 +1,253 @@
+"""Daemon configuration: a single TOML file plus env-var / file secrets.
+
+Mirrors reference src/util/config.rs:13-142 (knob inventory, defaults) and
+src/garage/secrets.rs (secret layering: inline < file < env).  New in the
+rebuild: `replication_mode` accepts `"ec:k:m"` to enable the TPU-batched
+erasure-coded block codec (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any
+
+DEFAULT_BLOCK_SIZE = 1024 * 1024  # 1 MiB, config.rs:273-275
+DEFAULT_COMPRESSION_LEVEL = 1  # zstd level, config.rs:284
+
+
+@dataclass
+class DataDir:
+    path: str
+    capacity: int | None = None  # bytes; None = unlimited single-dir mode
+    read_only: bool = False
+
+
+@dataclass
+class S3ApiConfig:
+    api_bind_addr: str | None = None
+    s3_region: str = "garage"
+    root_domain: str | None = None
+
+
+@dataclass
+class K2VApiConfig:
+    api_bind_addr: str | None = None
+
+
+@dataclass
+class WebConfig:
+    bind_addr: str | None = None
+    root_domain: str = ".web.garage"
+    add_host_to_metrics: bool = False
+
+
+@dataclass
+class AdminConfig:
+    api_bind_addr: str | None = None
+    admin_token: str | None = None
+    metrics_token: str | None = None
+    trace_sink: str | None = None
+
+
+@dataclass
+class TpuConfig:
+    """Rebuild-specific: the TPU compute plane used by the EC block codec and
+    batched scrub hashing (no analog in the reference)."""
+
+    enable: bool = True  # use jax backend if available, else numpy fallback
+    platform: str | None = None  # force "tpu"/"cpu"; None = jax default
+    batch_blocks: int = 1024  # blocks aggregated per EC/hash dispatch
+    max_dispatch_bytes: int = 256 * 1024 * 1024  # RAM budget per dispatch
+
+
+@dataclass
+class Config:
+    metadata_dir: str = ""
+    data_dir: list[DataDir] = field(default_factory=list)
+
+    db_engine: str = "sqlite"  # "sqlite" | "memory" (reference: lmdb|sqlite)
+    metadata_fsync: bool = True
+    data_fsync: bool = False
+    metadata_auto_snapshot_interval: int | None = None  # msec
+
+    block_size: int = DEFAULT_BLOCK_SIZE
+    block_ram_buffer_max: int = 256 * 1024 * 1024
+    compression_level: int | None = DEFAULT_COMPRESSION_LEVEL  # None = off
+
+    replication_factor: int = 1
+    consistency_mode: str = "consistent"  # consistent|degraded|dangerous
+    # Rebuild extension: "ec:k:m" selects the erasure-coded block codec;
+    # metadata tables always use plain replication_factor.
+    replication_mode: str | None = None
+
+    rpc_secret: str | None = None
+    rpc_secret_file: str | None = None
+    rpc_bind_addr: str = "127.0.0.1:3901"
+    rpc_bind_outgoing: bool = False
+    rpc_public_addr: str | None = None
+    rpc_timeout_msec: int = 10_000
+
+    bootstrap_peers: list[str] = field(default_factory=list)
+
+    allow_world_readable_secrets: bool = False
+
+    s3_api: S3ApiConfig = field(default_factory=S3ApiConfig)
+    k2v_api: K2VApiConfig = field(default_factory=K2VApiConfig)
+    s3_web: WebConfig = field(default_factory=WebConfig)
+    admin: AdminConfig = field(default_factory=AdminConfig)
+    tpu: TpuConfig = field(default_factory=TpuConfig)
+
+    # --- derived -----------------------------------------------------------
+
+    def ec_params(self) -> tuple[int, int] | None:
+        """(k, m) when replication_mode = "ec:k:m", else None."""
+        if self.replication_mode and self.replication_mode.startswith("ec:"):
+            m = re.fullmatch(r"ec:(\d+):(\d+)", self.replication_mode)
+            if not m:
+                raise ValueError(
+                    f"bad replication_mode {self.replication_mode!r}, want ec:k:m"
+                )
+            k, mm = int(m.group(1)), int(m.group(2))
+            if not (1 <= k <= 128 and 1 <= mm <= 128 and k + mm <= 255):
+                raise ValueError("ec:k:m out of range (k+m must be <= 255)")
+            return (k, mm)
+        return None
+
+
+def _get_secret(
+    inline: str | None, file_path: str | None, env_name: str, allow_world_readable: bool
+) -> str | None:
+    """Secret layering (reference src/garage/secrets.rs): env overrides file
+    overrides inline; file must not be world-readable."""
+    env = os.environ.get(env_name)
+    if env:
+        return env.strip()
+    if file_path:
+        st = os.stat(file_path)
+        # refuse any group/other access bits (reference src/garage/secrets.rs:128)
+        if st.st_mode & 0o077 and not allow_world_readable:
+            raise ValueError(
+                f"secret file {file_path} is accessible by group/others "
+                f"(mode {st.st_mode & 0o777:o}); refusing "
+                "(set allow_world_readable_secrets = true to override)"
+            )
+        with open(file_path) as f:
+            return f.read().strip()
+    return inline
+
+
+def read_config(path: str) -> Config:
+    with open(path, "rb") as f:
+        raw = tomllib.load(f)
+    return config_from_dict(raw)
+
+
+def config_from_dict(raw: dict[str, Any]) -> Config:
+    cfg = Config()
+    simple = {
+        f
+        for f in (
+            "metadata_dir db_engine metadata_fsync data_fsync block_size "
+            "block_ram_buffer_max replication_factor consistency_mode "
+            "replication_mode rpc_secret rpc_secret_file rpc_bind_addr "
+            "rpc_bind_outgoing rpc_public_addr rpc_timeout_msec "
+            "bootstrap_peers allow_world_readable_secrets "
+            "metadata_auto_snapshot_interval"
+        ).split()
+    }
+    for k, v in raw.items():
+        if k in simple:
+            setattr(cfg, k, v)
+        elif k == "compression_level":
+            # "none" disables; any integer (incl. 0) is a zstd level
+            # (reference src/util/config.rs:288-315)
+            if v == "none":
+                cfg.compression_level = None
+            elif isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"bad compression_level {v!r}")
+            else:
+                cfg.compression_level = v
+        elif k == "data_dir":
+            if isinstance(v, str):
+                cfg.data_dir = [DataDir(path=v)]
+            else:
+                cfg.data_dir = [
+                    DataDir(
+                        path=d["path"],
+                        capacity=_parse_capacity(d.get("capacity")),
+                        read_only=bool(d.get("read_only", False)),
+                    )
+                    for d in v
+                ]
+        elif k == "s3_api":
+            cfg.s3_api = S3ApiConfig(**_known(v, S3ApiConfig))
+        elif k == "k2v_api":
+            cfg.k2v_api = K2VApiConfig(**_known(v, K2VApiConfig))
+        elif k == "s3_web":
+            cfg.s3_web = WebConfig(**_known(v, WebConfig))
+        elif k == "admin":
+            cfg.admin = AdminConfig(**_known(v, AdminConfig))
+        elif k == "tpu":
+            cfg.tpu = TpuConfig(**_known(v, TpuConfig))
+        # unknown sections are ignored (forward compat)
+    # resolve secrets
+    cfg.rpc_secret = _get_secret(
+        cfg.rpc_secret,
+        cfg.rpc_secret_file,
+        "GARAGE_RPC_SECRET",
+        cfg.allow_world_readable_secrets,
+    )
+    cfg.admin.admin_token = _get_secret(
+        cfg.admin.admin_token, None, "GARAGE_ADMIN_TOKEN", True
+    )
+    cfg.admin.metrics_token = _get_secret(
+        cfg.admin.metrics_token, None, "GARAGE_METRICS_TOKEN", True
+    )
+    # parity with reference legacy replication_mode values
+    # ("1"|"2"|"3"|"2-dangerous"|"3-degraded"|"3-dangerous",
+    #  src/rpc/replication_mode.rs:74-80); "ec:k:m" is the rebuild extension
+    if cfg.replication_mode and not cfg.replication_mode.startswith("ec:"):
+        legacy = {
+            "1": (1, "consistent"),
+            "2": (2, "consistent"),
+            "2-dangerous": (2, "dangerous"),
+            "3": (3, "consistent"),
+            "3-degraded": (3, "degraded"),
+            "3-dangerous": (3, "dangerous"),
+        }
+        if cfg.replication_mode not in legacy:
+            raise ValueError(
+                f"invalid replication_mode {cfg.replication_mode!r} "
+                "(want 1|2|3[-degraded|-dangerous] or ec:k:m)"
+            )
+        cfg.replication_factor, cfg.consistency_mode = legacy[cfg.replication_mode]
+        cfg.replication_mode = None
+    cfg.ec_params()  # validate ec:k:m syntax at parse time
+    return cfg
+
+
+def _known(d: dict[str, Any], cls: type) -> dict[str, Any]:
+    fields = cls.__dataclass_fields__  # type: ignore[attr-defined]
+    return {k: v for k, v in d.items() if k in fields}
+
+
+_CAP_RE = re.compile(r"^\s*([0-9.]+)\s*([kKmMgGtT]?)(i?)[bB]?\s*$")
+_CAP_DEC = {"": 1, "k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12}
+_CAP_BIN = {"": 1, "k": 2**10, "m": 2**20, "g": 2**30, "t": 2**40}
+
+
+def _parse_capacity(v: Any) -> int | None:
+    """'1T' = 10^12, '1TiB' = 2^40 — same semantics as the reference's
+    bytesize crate (decimal for plain suffix, binary for the 'i' forms)."""
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return v
+    m = _CAP_RE.match(str(v))
+    if not m:
+        raise ValueError(f"bad capacity {v!r}")
+    mult = (_CAP_BIN if m.group(3) else _CAP_DEC)[m.group(2).lower()]
+    return int(float(m.group(1)) * mult)

@@ -1,0 +1,54 @@
+"""Atomic save/load of versioned values to a file.
+
+Mirrors reference src/util/persister.rs:10-112: write to a temp file in the
+same directory, fsync, rename over the target — so a crash never leaves a
+half-written state file.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Generic, TypeVar
+
+from .migrate import Migratable
+
+T = TypeVar("T", bound=Migratable)
+
+
+class Persister(Generic[T]):
+    def __init__(self, directory: str, name: str, typ: type[T]):
+        self.path = os.path.join(directory, name)
+        self.typ = typ
+
+    def load(self) -> T | None:
+        try:
+            with open(self.path, "rb") as f:
+                return self.typ.decode(f.read())
+        except FileNotFoundError:
+            return None
+
+    def save(self, value: T) -> None:
+        data = value.encode()
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def load_raw(self) -> bytes | None:
+        try:
+            with open(self.path, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def save_raw(self, data: bytes) -> None:
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)

@@ -1,0 +1,22 @@
+"""Time helpers (reference src/util/time.rs)."""
+
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+
+
+def now_msec() -> int:
+    """Milliseconds since the unix epoch."""
+    return int(time.time() * 1000)
+
+
+def increment_logical_clock(prev: int) -> int:
+    """max(now, prev+1) — monotone timestamps for LWW registers
+    (reference src/util/time.rs:9-13)."""
+    return max(now_msec(), prev + 1)
+
+
+def msec_to_rfc3339(msecs: int) -> str:
+    dt = datetime.fromtimestamp(msecs / 1000.0, tz=timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{msecs % 1000:03d}Z"

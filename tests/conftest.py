@@ -1,0 +1,27 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (the driver separately
+dry-run-compiles the multi-chip path); set the flags before any jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def db(request, tmp_path):
+    """Dual-engine DB fixture: every db test runs against all engines
+    (reference src/db/test.rs:127-144 pattern)."""
+    from garage_tpu.db import open_db
+
+    d = open_db(str(tmp_path / "db"), engine=request.param)
+    yield d
+    d.close()

@@ -1,0 +1,128 @@
+"""CRDT laws: idempotent, commutative, associative merges
+(reference src/util/crdt tests)."""
+
+import random
+
+from garage_tpu.utils.crdt import Bool, CrdtMap, Deletable, Lww, LwwMap
+
+
+def merged(a, b):
+    import copy
+
+    c = copy.deepcopy(a)
+    c.merge(copy.deepcopy(b))
+    return c
+
+
+def assert_crdt_laws(vals):
+    import copy
+
+    for a in vals:
+        assert merged(a, a) == a, "idempotent"
+    for a in vals:
+        for b in vals:
+            assert merged(a, b) == merged(b, a), f"commutative {a} {b}"
+    for a in vals:
+        for b in vals:
+            for c in vals:
+                assert merged(merged(a, b), c) == merged(a, merged(b, c)), "associative"
+
+
+def test_lww():
+    a = Lww.raw(10, "x")
+    b = Lww.raw(20, "y")
+    c = Lww.raw(20, "z")
+    assert_crdt_laws([a, b, c])
+    assert merged(a, b).get() == "y"
+    assert merged(b, c).get() == "z"  # tie broken by value order
+
+
+def test_lww_update_monotone():
+    a = Lww.raw(10**15, "x")
+    ts0 = a.ts
+    a.update("y")
+    assert a.ts > ts0 and a.get() == "y"
+
+
+def test_bool():
+    assert_crdt_laws([Bool(False), Bool(True)])
+    assert merged(Bool(False), Bool(True)).get() is True
+
+
+def test_lww_map():
+    a = LwwMap([("k1", 5, "a"), ("k2", 6, "b")])
+    b = LwwMap([("k1", 7, "c"), ("k3", 1, "d")])
+    c = LwwMap([("k2", 6, "e")])
+    assert_crdt_laws([a, b, c])
+    m = merged(a, b)
+    assert m.get("k1") == "c" and m.get("k2") == "b" and m.get("k3") == "d"
+
+
+def test_lww_map_mutator():
+    a = LwwMap([("k", 5, "a")])
+    mut = a.update_mutator("k", "b")
+    a.merge(mut)
+    assert a.get("k") == "b"
+
+
+def test_crdt_map_nested():
+    a = CrdtMap([("k", Bool(False))])
+    b = CrdtMap([("k", Bool(True)), ("j", Bool(False))])
+    assert_crdt_laws([a, b])
+    m = merged(a, b)
+    assert m.get("k").get() is True and m.get("j").get() is False
+
+
+def test_deletable():
+    p1 = Deletable.present(Lww.raw(1, "x"))
+    p2 = Deletable.present(Lww.raw(2, "y"))
+    d = Deletable.deleted()
+    assert_crdt_laws([p1, p2, d])
+    assert merged(p1, d).is_deleted()
+    assert merged(p1, p2).get().get() == "y"
+
+
+def test_random_lww_map_convergence():
+    """Three replicas applying the same ops in different orders converge."""
+    rng = random.Random(42)
+    ops = [LwwMap([(f"k{rng.randrange(8)}", rng.randrange(100), rng.randrange(1000))])
+           for _ in range(60)]
+    replicas = []
+    for _ in range(3):
+        order = ops[:]
+        rng.shuffle(order)
+        r = LwwMap()
+        for op in order:
+            r.merge(op)
+        replicas.append(r)
+    assert replicas[0] == replicas[1] == replicas[2]
+
+
+def test_serialization_roundtrip():
+    m = LwwMap([("k1", 5, "a"), ("k2", 6, [1, 2, 3])])
+    assert LwwMap.from_obj(m.to_obj()) == m
+    d = Deletable.present(Bool(True))
+    assert Deletable.from_obj(d.to_obj(), Bool.from_obj).to_obj() == d.to_obj()
+
+
+def test_lww_map_tie_merges_nested_crdt():
+    """Timestamp ties must CRDT-merge values, not drop one side
+    (reference lww_map.rs merge_raw Ordering::Equal)."""
+    a = LwwMap([("k", 5, CrdtMap([("a", Bool(True))]))])
+    b = LwwMap([("k", 5, CrdtMap([("b", Bool(True))]))])
+    m = merged(a, b)
+    assert m.get("k").get("a").get() is True
+    assert m.get("k").get("b").get() is True
+    assert_crdt_laws([a, b])
+
+
+def test_merge_does_not_alias_mutator():
+    """After a.merge(update), editing a must not mutate `update`
+    (callers re-broadcast update objects)."""
+    update = LwwMap([("k", 99, CrdtMap([("x", Bool(False))]))])
+    a = LwwMap([("k", 1, CrdtMap([("y", Bool(False))]))])
+    a.merge(update)
+    a.get("k").put("z", Bool(True))
+    a.get("k").get("x").set()
+    assert update.get("k").get("z") is None
+    assert update.get("k").get("x").get() is False

@@ -1,0 +1,137 @@
+"""DB abstraction tests, run against every engine (reference src/db/test.rs)."""
+
+import pytest
+
+from garage_tpu.db import TxAbort
+
+
+def test_basic_ops(db):
+    t = db.open_tree("t1")
+    assert t.get(b"k") is None
+    t.insert(b"k", b"v")
+    assert t.get(b"k") == b"v"
+    t.insert(b"k", b"v2")
+    assert t.get(b"k") == b"v2"
+    assert len(t) == 1
+    t.remove(b"k")
+    assert t.get(b"k") is None
+    assert len(t) == 0
+
+
+def test_range_iter(db):
+    t = db.open_tree("t2")
+    for i in range(10):
+        t.insert(bytes([i]), bytes([i * 2]))
+    allkv = list(t.iter_range())
+    assert [k for k, _ in allkv] == [bytes([i]) for i in range(10)]
+    part = list(t.iter_range(start=bytes([3]), end=bytes([7])))
+    assert [k for k, _ in part] == [bytes([i]) for i in range(3, 7)]
+    rev = list(t.iter_range(reverse=True))
+    assert [k for k, _ in rev] == [bytes([i]) for i in reversed(range(10))]
+
+
+def test_prefix_iter(db):
+    t = db.open_tree("t3")
+    t.insert(b"aa1", b"1")
+    t.insert(b"aa2", b"2")
+    t.insert(b"ab1", b"3")
+    assert [k for k, _ in t.iter_prefix(b"aa")] == [b"aa1", b"aa2"]
+    # prefix ending in 0xff
+    t.insert(b"\xff\x01", b"x")
+    t.insert(b"\xff\x02", b"y")
+    assert len(list(t.iter_prefix(b"\xff"))) == 2
+
+
+def test_get_gt_first(db):
+    t = db.open_tree("t4")
+    t.insert(b"b", b"1")
+    t.insert(b"d", b"2")
+    assert t.first() == (b"b", b"1")
+    assert t.get_gt(b"b") == (b"d", b"2")
+    assert t.get_gt(b"d") is None
+
+
+def test_transaction_commit_rollback(db):
+    t1 = db.open_tree("ta")
+    t2 = db.open_tree("tb")
+
+    def txf(tx):
+        tx.insert(t1, b"x", b"1")
+        tx.insert(t2, b"y", b"2")
+        return "ok"
+
+    assert db.transaction(txf) == "ok"
+    assert t1.get(b"x") == b"1" and t2.get(b"y") == b"2"
+
+    def txfail(tx):
+        tx.insert(t1, b"x", b"changed")
+        tx.remove(t2, b"y")
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        db.transaction(txfail)
+    assert t1.get(b"x") == b"1" and t2.get(b"y") == b"2"
+
+    def txabort(tx):
+        tx.insert(t1, b"x", b"changed")
+        raise TxAbort(value=42)
+
+    assert db.transaction(txabort) == 42
+    assert t1.get(b"x") == b"1"
+
+
+def test_tx_read_your_writes(db):
+    t = db.open_tree("tc")
+
+    def txf(tx):
+        tx.insert(t, b"k", b"v")
+        assert tx.get(t, b"k") == b"v"
+        tx.remove(t, b"k")
+        assert tx.get(t, b"k") is None
+        tx.insert(t, b"k", b"v2")
+        return tx.len(t)
+
+    assert db.transaction(txf) == 1
+    assert t.get(b"k") == b"v2"
+
+
+def test_list_trees(db):
+    db.open_tree("z_tree")
+    db.open_tree("a_tree")
+    names = db.list_trees()
+    assert "z_tree" in names and "a_tree" in names
+
+
+def test_iterate_while_mutating(db):
+    """GC/sync workers iterate a tree and delete as they go — both engines
+    must tolerate mutation mid-iteration."""
+    t = db.open_tree("mut")
+    for i in range(50):
+        t.insert(bytes([i]), b"v")
+    seen = []
+    for k, _v in t.iter_range():
+        seen.append(k)
+        t.remove(k)
+    assert len(seen) == 50 and len(t) == 0
+    # reverse direction too
+    for i in range(50):
+        t.insert(bytes([i]), b"v")
+    seen = []
+    for k, _v in t.iter_range(reverse=True):
+        seen.append(k)
+        t.remove(k)
+    assert seen == [bytes([i]) for i in reversed(range(50))] and len(t) == 0
+
+
+def test_autocommit_op_inside_tx_refused(db):
+    """Auto-commit Tree ops inside a transaction() would break atomicity;
+    both engines must refuse them."""
+    t = db.open_tree("guard")
+
+    def bad(tx):
+        tx.insert(t, b"a", b"1")
+        t.insert(b"b", b"2")  # wrong: bypasses the Tx handle
+
+    with pytest.raises(RuntimeError):
+        db.transaction(bad)
+    assert t.get(b"a") is None and t.get(b"b") is None

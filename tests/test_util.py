@@ -1,0 +1,188 @@
+"""utils: migrate chains, persister atomicity, config parsing, tranquilizer."""
+
+import os
+
+import pytest
+
+from garage_tpu.utils.config import config_from_dict
+from garage_tpu.utils.data import blake2sum, gen_uuid, hex_of, parse_hex
+from garage_tpu.utils.migrate import Migratable
+from garage_tpu.utils.persister import Persister
+
+
+class ThingV0(Migratable):
+    VERSION_MARKER = b"G0thing"
+
+    def __init__(self, a):
+        self.a = a
+
+    def to_obj(self):
+        return {"a": self.a}
+
+    @classmethod
+    def from_obj(cls, obj):
+        return cls(obj["a"])
+
+
+class ThingV1(Migratable):
+    VERSION_MARKER = b"G1thing"
+    PREVIOUS = ThingV0
+
+    def __init__(self, a, b):
+        self.a = a
+        self.b = b
+
+    def to_obj(self):
+        return {"a": self.a, "b": self.b}
+
+    @classmethod
+    def from_obj(cls, obj):
+        return cls(obj["a"], obj["b"])
+
+    @classmethod
+    def migrate_from(cls, prev):
+        return cls(prev.a, "default")
+
+
+def test_migrate_roundtrip_and_chain():
+    v0 = ThingV0(5)
+    data = v0.encode()
+    assert data.startswith(b"G0thing")
+    # current version decodes its own format
+    assert ThingV0.decode(data).a == 5
+    # new version decodes old format through the migration chain
+    v1 = ThingV1.decode(data)
+    assert v1.a == 5 and v1.b == "default"
+    # and its own format
+    assert ThingV1.decode(v1.encode()).b == "default"
+    with pytest.raises(ValueError):
+        ThingV0.decode(b"GXother" + b"\x00")
+
+
+def test_persister(tmp_path):
+    p = Persister(str(tmp_path), "thing", ThingV1)
+    assert p.load() is None
+    p.save(ThingV1(1, "x"))
+    got = p.load()
+    assert got.a == 1 and got.b == "x"
+    assert not os.path.exists(p.path + ".tmp")
+
+
+def test_data_helpers():
+    u1, u2 = gen_uuid(), gen_uuid()
+    assert len(u1) == 32 and u1 != u2
+    h = blake2sum(b"hello")
+    assert len(h) == 32
+    assert parse_hex(hex_of(h)) == h
+
+
+def test_config_parsing():
+    cfg = config_from_dict(
+        {
+            "metadata_dir": "/tmp/meta",
+            "data_dir": "/tmp/data",
+            "replication_factor": 3,
+            "block_size": 1048576,
+            "compression_level": "none",
+            "s3_api": {"api_bind_addr": "127.0.0.1:3900", "s3_region": "garage"},
+            "admin": {"api_bind_addr": "127.0.0.1:3903", "admin_token": "tok"},
+        }
+    )
+    assert cfg.replication_factor == 3
+    assert cfg.data_dir[0].path == "/tmp/data"
+    assert cfg.compression_level is None
+    assert cfg.s3_api.api_bind_addr == "127.0.0.1:3900"
+    assert cfg.admin.admin_token == "tok"
+    assert cfg.ec_params() is None
+
+
+def test_config_multidir_and_ec():
+    cfg = config_from_dict(
+        {
+            "metadata_dir": "/tmp/meta",
+            "data_dir": [
+                {"path": "/d1", "capacity": "1T"},
+                {"path": "/d2", "capacity": "500G", "read_only": True},
+            ],
+            "replication_mode": "ec:8:3",
+        }
+    )
+    assert cfg.data_dir[0].capacity == 10**12
+    assert cfg.data_dir[1].read_only
+    assert cfg.ec_params() == (8, 3)
+
+
+def test_config_legacy_replication_mode():
+    cfg = config_from_dict({"replication_mode": "3"})
+    assert cfg.replication_factor == 3 and cfg.replication_mode is None
+
+
+def test_secret_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("GARAGE_RPC_SECRET", "sekrit")
+    cfg = config_from_dict({})
+    assert cfg.rpc_secret == "sekrit"
+
+
+def test_capacity_binary_vs_decimal():
+    from garage_tpu.utils.config import _parse_capacity
+
+    assert _parse_capacity("1T") == 10**12
+    assert _parse_capacity("1TiB") == 2**40
+    assert _parse_capacity("1.5GiB") == int(1.5 * 2**30)
+    assert _parse_capacity(12345) == 12345
+
+
+def test_legacy_replication_modes():
+    cfg = config_from_dict({"replication_mode": "3-degraded"})
+    assert cfg.replication_factor == 3 and cfg.consistency_mode == "degraded"
+    with pytest.raises(ValueError):
+        config_from_dict({"replication_mode": "4-bogus"})
+
+
+def test_secret_file_group_readable_refused(tmp_path):
+    sf = tmp_path / "secret"
+    sf.write_text("s")
+    os.chmod(sf, 0o640)
+    with pytest.raises(ValueError):
+        config_from_dict({"rpc_secret_file": str(sf)})
+    os.chmod(sf, 0o600)
+    assert config_from_dict({"rpc_secret_file": str(sf)}).rpc_secret == "s"
+
+
+def test_compression_level_zero():
+    assert config_from_dict({"compression_level": 0}).compression_level == 0
+    assert config_from_dict({"compression_level": "none"}).compression_level is None
+    with pytest.raises(ValueError):
+        config_from_dict({"compression_level": "max"})
+
+
+def test_migrate_fallthrough_on_bad_payload():
+    """Same marker but unparseable payload falls through the version chain
+    (reference migrate.rs tries each version in turn)."""
+    # V1 marker with a V0-shaped payload (missing "b") → falls back is not
+    # possible since markers differ; simulate same-marker schema change:
+    import msgpack
+
+    bad = ThingV1.VERSION_MARKER + msgpack.packb(["not", "a", "map"])
+
+    class ThingV2(Migratable):
+        VERSION_MARKER = ThingV1.VERSION_MARKER  # same marker, new schema
+        PREVIOUS = ThingV0
+
+        def to_obj(self):
+            return {}
+
+        @classmethod
+        def from_obj(cls, obj):
+            return cls()
+
+        @classmethod
+        def migrate_from(cls, prev):
+            inst = cls()
+            inst.migrated = prev.a
+            return inst
+
+    got = ThingV2.decode(ThingV0(7).encode())
+    assert got.migrated == 7
+    with pytest.raises(Exception):
+        ThingV0.decode(bad + b"")  # V0 has no PREVIOUS: error surfaces
