@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Headline benchmark: EC(8,3) erasure-encode throughput per chip.
+
+Runs the flagship fused pipeline (GF(2^8) bit-plane matmul encode of 1 MiB
+blocks) on the default JAX backend and prints ONE JSON line:
+
+    {"metric": "ec83_encode_GBps", "value": N, "unit": "GB/s",
+     "vs_baseline": N / 10.0}
+
+Baseline (BASELINE.md north star): >= 10 GB/s EC(8,3) encode+repair on one
+v5e chip.  `vs_baseline` > 1.0 means the target is beaten.
+
+Flags: --batch (blocks per dispatch), --iters, --hash (also compute BLAKE3
+shard hashes in the same dispatch), --repair (measure reconstruction of m
+lost shards instead of encode).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--m", type=int, default=3)
+    ap.add_argument("--block-bytes", type=int, default=1024 * 1024)
+    ap.add_argument("--batch", type=int, default=64, help="blocks per dispatch")
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--hash", action="store_true", help="fuse BLAKE3 shard hashing")
+    ap.add_argument("--repair", action="store_true", help="bench reconstruction")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from garage_tpu.models.pipeline import ScrubRepairPipeline
+    from garage_tpu.ops import gf
+
+    k, m = args.k, args.m
+    shard_bytes = args.block_bytes // k
+    pipe = ScrubRepairPipeline(k=k, m=m, shard_bytes=shard_bytes)
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (args.batch, k, shard_bytes), dtype=np.uint8)
+    data_dev = jax.device_put(jnp.asarray(data))
+    dev = jax.devices()[0]
+    if args.verbose:
+        print(f"# backend={dev.platform} device={dev}", file=sys.stderr)
+
+    if args.hash and args.repair:
+        ap.error("--hash and --repair are mutually exclusive")
+    if args.hash:
+        fn = pipe.jitted()
+
+        def run(x):
+            p, h, s = fn(x)
+            return p
+    elif args.repair:
+        from garage_tpu.ops.ec_tpu import _apply_fn
+
+        # lose the first m data shards; reconstruct from survivors
+        present = list(range(m, k + m))
+        rmat = gf.reconstruction_matrix(k, m, present[:k], list(range(m)))
+        bitmat = jnp.asarray(gf.bitmatrix_of(rmat), dtype=jnp.bfloat16)
+        apply_fn = _apply_fn(None)
+
+        def run(x):
+            return apply_fn(bitmat, x)
+    else:
+        from garage_tpu.ops.ec_tpu import _apply_fn
+
+        bitmat = jnp.asarray(
+            gf.bitmatrix_of(gf.cauchy_parity_matrix(k, m)), dtype=jnp.bfloat16
+        )
+        apply_fn = _apply_fn(None)
+
+        def run(x):
+            return apply_fn(bitmat, x)
+
+    def sync(x):
+        # On the tunneled axon platform block_until_ready can return before
+        # execution finishes; a 1-byte host fetch is the honest barrier.
+        np.asarray(x[(0,) * (x.ndim - 1)][:1])
+
+    for _ in range(args.warmup):
+        sync(run(data_dev))
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = run(data_dev)
+    sync(out)
+    dt = time.perf_counter() - t0
+
+    bytes_per_iter = args.batch * k * shard_bytes  # data bytes coded
+    gbps = bytes_per_iter * args.iters / dt / 1e9
+    metric = "ec%d%d_%s_GBps" % (k, m, "repair" if args.repair else "encode")
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / 10.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

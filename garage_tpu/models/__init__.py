@@ -1,0 +1,3 @@
+from .pipeline import ScrubRepairPipeline
+
+__all__ = ["ScrubRepairPipeline"]

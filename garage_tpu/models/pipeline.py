@@ -1,0 +1,108 @@
+"""Flagship compute pipeline: fused EC coding + BLAKE3 shard hashing.
+
+This is the "model" the bench and graft entry drive: one XLA dispatch that
+takes a batch of blocks (split into k data shards each) and produces the m
+parity shards plus the 32-byte integrity hash of every one of the k+m
+shards — the write-path and scrub/repair hot math of the erasure-coded
+block store (BASELINE.json north star), with no host round-trips inside.
+
+Multi-chip: the batch dimension shards over a `Mesh` ("blocks" axis); the
+only cross-device communication is a tiny psum of scrub statistics, so the
+pipeline scales linearly over ICI (pod-level repair fan-out).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..ops import gf
+from ..ops.hash_tpu import blake3_batch_fn
+
+
+class ScrubRepairPipeline:
+    """EC(k, m) + shard hashing, fixed shard size, batched over blocks.
+
+    shard_bytes must be a supported BLAKE3 batch length (multiple of 64 up
+    to 1024, or a power-of-two number of KiB) — the block layer pads shards
+    to these sizes.
+    """
+
+    def __init__(self, k: int = 8, m: int = 3, shard_bytes: int = 128 * 1024):
+        self.k, self.m, self.shard_bytes = k, m, shard_bytes
+        self._enc_bitmat_np = gf.bitmatrix_of(gf.cauchy_parity_matrix(k, m))
+        # build lazily so importing this module never touches jax
+        self._fns: dict = {}
+
+    # --- single-device fns --------------------------------------------------
+
+    def encode_and_hash_fn(self):
+        """Jittable fn: data (B, k, S) uint8 -> (parity (B, m, S),
+        hashes (B, k+m, 32), scrub_stats (2,))."""
+        import jax.numpy as jnp
+
+        from ..ops.ec_tpu import gf_bitmatmul
+
+        k, m, s = self.k, self.m, self.shard_bytes
+        enc_bitmat = jnp.asarray(self._enc_bitmat_np, dtype=jnp.bfloat16)
+        hash_fn = blake3_batch_fn(s)
+
+        def fwd(data):
+            b = data.shape[0]
+            parity = gf_bitmatmul(enc_bitmat, data)
+            shards = jnp.concatenate([data, parity], axis=1)  # (B, k+m, S)
+            hashes = hash_fn(shards.reshape(b * (k + m), s)).reshape(b, k + m, 32)
+            # scrub stats: block count + exact xor-fold of all hash words
+            # (a corruption-sensitive fleet summary).  XOR is realized as
+            # per-bit add-reduce mod 2 — GSPMD supports add all-reduce on
+            # every backend, unlike a bitwise-xor reduction.
+            hw = hashes.reshape(b, (k + m) * 8, 4).astype(jnp.uint32)
+            hwords = hw[..., 0] | (hw[..., 1] << 8) | (hw[..., 2] << 16) | (hw[..., 3] << 24)
+            bitpos = jnp.arange(32, dtype=jnp.uint32)
+            hbits = (hwords[..., None] >> bitpos) & 1  # (B, W, 32)
+            parities = hbits.astype(jnp.int32).sum(axis=(0, 1)) & 1  # (32,)
+            fold = (parities.astype(jnp.uint32) << bitpos).sum(dtype=jnp.uint32)
+            stats = jnp.stack([jnp.uint32(b), fold])
+            return parity, hashes, stats
+
+        return fwd
+
+    def jitted(self):
+        import jax
+
+        if "jit" not in self._fns:
+            self._fns["jit"] = jax.jit(self.encode_and_hash_fn())
+        return self._fns["jit"]
+
+    def example_batch(self, batch: int = 4, seed: int = 0) -> np.ndarray:
+        rng = np.random.default_rng(seed)
+        return rng.integers(
+            0, 256, (batch, self.k, self.shard_bytes), dtype=np.uint8
+        )
+
+    # --- multi-chip step ----------------------------------------------------
+
+    def sharded_step(self, mesh):
+        """The full multi-chip repair/scrub step jitted over `mesh`:
+        block-batch sharded over the "blocks" axis, coding matrices
+        replicated, scrub stats psum-reduced across the mesh."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        fwd = self.encode_and_hash_fn()
+        data_sharding = NamedSharding(mesh, P("blocks"))
+        out_shardings = (
+            NamedSharding(mesh, P("blocks")),
+            NamedSharding(mesh, P("blocks")),
+            NamedSharding(mesh, P()),
+        )
+
+        def step(data):
+            parity, hashes, stats = fwd(data)
+            return parity, hashes, stats
+
+        return jax.jit(
+            step, in_shardings=(data_sharding,), out_shardings=out_shardings
+        )

@@ -1,0 +1,14 @@
+"""TPU compute kernels (JAX/XLA) and their CPU reference implementations.
+
+This layer is pure array math — no storage or RPC types.  The block store's
+`BlockCodec` (garage_tpu/block/codec/) is the seam that feeds it.
+
+  gf.py       GF(2^8) arithmetic, Cauchy Reed-Solomon matrices, bit-matrix
+              expansion, and a vectorized numpy reference codec (the oracle
+              every TPU kernel is checked bit-for-bit against).
+  ec_tpu.py   The TPU codec: erasure encode/reconstruct as int8 bit-plane
+              matmuls on the MXU, batched over thousands of blocks per
+              dispatch.
+  blake3_ref.py  Pure-Python BLAKE3 (oracle).
+  hash_tpu.py    Batched BLAKE3 over blocks in JAX (scrub offload).
+"""

@@ -1,0 +1,3 @@
+from .mesh import block_sharding, make_mesh, replicated
+
+__all__ = ["make_mesh", "block_sharding", "replicated"]

@@ -1,0 +1,52 @@
+"""Device-mesh sharding for pod-level EC repair fan-out.
+
+The storage protocol itself (quorums, gossip, anti-entropy) runs host-side
+over DCN — the reference has no NCCL/MPI analog to port (SURVEY.md §2.3).
+The TPU mesh is used where the math is: batched erasure coding and scrub
+hashing shard embarrassingly over blocks ("blocks" axis = the DP analog),
+with a small `psum` only for fleet-wide scrub statistics.  Laid out so all
+collectives ride ICI.
+"""
+
+from __future__ import annotations
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "blocks"):
+    """1-D mesh over the first n devices (or all)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            # dry-run path: fall back to the virtual CPU devices
+            # (--xla_force_host_platform_device_count)
+            try:
+                cpus = jax.devices("cpu")
+            except RuntimeError:
+                cpus = []
+            if len(cpus) >= n_devices:
+                devs = cpus
+            else:
+                raise RuntimeError(
+                    f"need {n_devices} devices, jax sees {len(devs)} "
+                    f"(+{len(cpus)} cpu); set "
+                    "--xla_force_host_platform_device_count for CPU dry-runs"
+                )
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (axis,))
+
+
+def block_sharding(mesh, axis: str = "blocks"):
+    """Shard the leading (block-batch) dimension across the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())

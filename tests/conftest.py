@@ -13,6 +13,18 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Pin jax to the CPU backend BEFORE any backend is initialized.  The axon
+# image's sitecustomize registers a (tunneled) TPU plugin in every python
+# process; initializing it from tests is slow and hangs if the tunnel is
+# busy.  jax.config wins over the sitecustomize as long as it runs before
+# the first jax.devices()/dispatch, which conftest import time guarantees.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 
