@@ -1,0 +1,396 @@
+"""Multiplexed RPC connection: chunked frames with priority QoS.
+
+Wire protocol inside the encrypted channel (my design; the reference's
+equivalent is src/net/send.rs:17-110 chunk framing + round-robin scheduler):
+
+  frame = [kind u8][flags u8][id u32][payload...]      (<= 16 KiB payload)
+  kinds: 1=REQ_META 2=RESP_META 3=BODY 4=STREAM 5=CANCEL
+  flags: FIN=1 (last chunk of body/stream), ERR=2 (response is an error)
+
+A message is sent as META, then BODY chunks (FIN on last), then — if a
+byte stream is attached — STREAM chunks (FIN on last, possibly empty).
+
+The send scheduler keeps one queue of in-flight message generators per
+priority level and interleaves chunks round-robin within a level, always
+draining higher-priority levels first: a huge BACKGROUND resync transfer
+adds at most one chunk of latency to a HIGH quorum RPC on the same
+connection — this is the QoS that keeps repair from starving PUT/GET.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from ..utils.serde import pack as _pack, unpack as _unpack
+from .handshake import FramedBox
+from .message import N_PRIO_LEVELS, PRIO_NORMAL, Req, Resp, prio_level
+from .stream import StreamWriter
+
+logger = logging.getLogger("garage.net")
+
+CHUNK = 16 * 1024
+
+K_REQ_META = 1
+K_RESP_META = 2
+K_BODY = 3
+K_STREAM = 4
+K_CANCEL = 5
+
+F_FIN = 1
+F_ERR = 2
+
+
+class RemoteError(Exception):
+    pass
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class _Outgoing:
+    """One message being sent: frames yielded chunk by chunk."""
+
+    __slots__ = ("frames", "rid", "aborted")
+
+    def __init__(self, frames, rid: int):
+        self.frames = frames  # async iterator of (kind, flags, id, payload)
+        self.rid = rid
+        self.aborted = False
+
+
+async def _frames_of(
+    kind_meta: int,
+    rid: int,
+    meta: dict,
+    body: bytes,
+    stream: AsyncIterator[bytes] | None,
+):
+    """Async generator of frames for one message."""
+    yield (kind_meta, 0, rid, _pack(meta))
+    if body or stream is None:
+        n = max(1, (len(body) + CHUNK - 1) // CHUNK)
+        for i in range(n):
+            part = body[i * CHUNK : (i + 1) * CHUNK]
+            fin = F_FIN if i == n - 1 else 0
+            yield (K_BODY, fin, rid, part)
+    else:
+        yield (K_BODY, F_FIN, rid, b"")
+    if stream is not None:
+        pending = b""
+        async for chunk in stream:
+            pending += chunk
+            while len(pending) >= CHUNK:
+                yield (K_STREAM, 0, rid, pending[:CHUNK])
+                pending = pending[CHUNK:]
+        yield (K_STREAM, F_FIN, rid, pending)
+
+
+class Connection:
+    """One authenticated, multiplexed peer connection (either direction)."""
+
+    def __init__(
+        self,
+        box: FramedBox,
+        handler: Callable[[str, bytes, Req], Awaitable[Resp]] | None,
+        on_close: Callable[["Connection"], None] | None = None,
+        initiator: bool = False,
+    ):
+        self.box = box
+        self.peer_id: bytes = box.peer_id
+        self.handler = handler
+        self.on_close = on_close
+        # Request ids must not collide between the two directions of the
+        # connection: the dialing side uses odd rids, the accepting side
+        # even, and frames are routed by rid parity.
+        self.initiator = initiator
+        self._next_id = 1 if initiator else 2
+        self._send_queues: list[asyncio.Queue] = [
+            asyncio.Queue() for _ in range(N_PRIO_LEVELS)
+        ]
+        self._send_wakeup = asyncio.Event()
+        # in-flight requests we sent: id -> (resp future, stream writer slot)
+        self._pending: dict[int, dict] = {}
+        # in-flight requests we are receiving: id -> partial state
+        self._incoming: dict[int, dict] = {}
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    def start(self) -> None:
+        self._tasks.append(asyncio.create_task(self._send_loop()))
+        self._tasks.append(asyncio.create_task(self._recv_loop()))
+
+    # --- sending -------------------------------------------------------------
+
+    async def call(
+        self,
+        endpoint: str,
+        req: Req,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = 30.0,
+    ) -> Resp:
+        """Send a request, await the response (body complete; stream may
+        continue arriving afterwards)."""
+        if self._closed:
+            raise ConnectionClosed("connection closed")
+        rid = self._next_id
+        self._next_id += 2
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[rid] = {"fut": fut}
+        meta = {
+            "ep": endpoint,
+            "prio": prio,
+            "hs": req.stream is not None,
+            "ot": req.order_tag.to_obj() if req.order_tag else None,
+        }
+        frames = _frames_of(K_REQ_META, rid, meta, _pack(req.body), req.stream)
+        out = await self._enqueue(prio, frames, rid)
+        self._pending[rid]["out"] = out
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            self._pending.pop(rid, None)
+            out.aborted = True  # stop transmitting remaining chunks
+            await self._enqueue(0, _one_frame(K_CANCEL, 0, rid, b""), rid)
+            raise
+
+    def _rid_is_mine(self, rid: int) -> bool:
+        return (rid & 1) == (1 if self.initiator else 0)
+
+    async def _enqueue(self, prio: int, frames, rid: int) -> _Outgoing:
+        out = _Outgoing(frames, rid)
+        self._send_queues[prio_level(prio)].put_nowait(out)
+        self._send_wakeup.set()
+        return out
+
+    async def _send_loop(self) -> None:
+        try:
+            while not self._closed:
+                out = None
+                for q in self._send_queues:
+                    if not q.empty():
+                        out = q.get_nowait()
+                        lvl = self._send_queues.index(q)
+                        break
+                if out is None:
+                    self._send_wakeup.clear()
+                    await self._send_wakeup.wait()
+                    continue
+                if out.aborted:
+                    continue  # caller gave up: drop remaining chunks
+                # send ONE chunk of this message, then rotate it to the back
+                # of its level queue (round-robin within priority)
+                try:
+                    frame = await out.frames.__anext__()
+                except StopAsyncIteration:
+                    continue
+                except Exception as e:  # stream producer failed mid-message
+                    logger.warning(
+                        "stream producer error on rid %d: %r", out.rid, e
+                    )
+                    # terminate the half-sent message so the peer's handler
+                    # isn't left waiting on a stream that never ends
+                    self.box.send_frame(
+                        struct.pack("<BBI", K_CANCEL, 0, out.rid)
+                    )
+                    await self.box.drain()
+                    # if it was our own request, fail the caller immediately
+                    p = self._pending.pop(out.rid, None)
+                    if p:
+                        fut = p.get("fut")
+                        if fut and not fut.done():
+                            fut.set_exception(e)
+                        if p.get("writer"):
+                            await p["writer"].close(f"request aborted: {e}")
+                    continue
+                kind, flags, rid, payload = frame
+                self.box.send_frame(
+                    struct.pack("<BBI", kind, flags, rid) + payload
+                )
+                await self.box.drain()
+                self._send_queues[lvl].put_nowait(out)
+        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+            pass
+        except Exception as e:
+            logger.warning("send loop error: %r", e)
+        finally:
+            await self._teardown()
+
+    # --- receiving -----------------------------------------------------------
+
+    async def _recv_loop(self) -> None:
+        try:
+            while not self._closed:
+                frame = await self.box.recv_frame()
+                kind, flags, rid = struct.unpack("<BBI", frame[:6])
+                payload = frame[6:]
+                if kind == K_REQ_META:
+                    self._incoming[rid] = {
+                        "meta": _unpack(payload),
+                        "body": [],
+                        "writer": None,
+                    }
+                elif kind == K_RESP_META:
+                    p = self._pending.get(rid)
+                    if p is not None:
+                        p["meta"] = _unpack(payload)
+                        p["body"] = []
+                elif kind == K_BODY:
+                    await self._on_body(rid, flags, payload)
+                elif kind == K_STREAM:
+                    await self._on_stream(rid, flags, payload)
+                elif kind == K_CANCEL:
+                    if self._rid_is_mine(rid):
+                        # peer aborted its response (e.g. stream producer
+                        # failed server-side)
+                        p = self._pending.pop(rid, None)
+                        if p:
+                            fut = p.get("fut")
+                            if fut and not fut.done():
+                                fut.set_exception(RemoteError("cancelled by peer"))
+                            if p.get("writer"):
+                                await p["writer"].close("cancelled by peer")
+                    else:
+                        st = self._incoming.pop(rid, None)
+                        if st:
+                            # close the stream first so a handler blocked on
+                            # it fails with a StreamError, then cancel
+                            if st.get("writer"):
+                                await st["writer"].close("cancelled by peer")
+                            if st.get("task"):
+                                st["task"].cancel()
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            asyncio.CancelledError,
+        ):
+            pass
+        except Exception as e:
+            logger.warning("recv loop error: %r", e)
+        finally:
+            await self._teardown()
+
+    async def _on_body(self, rid: int, flags: int, payload: bytes) -> None:
+        if not self._rid_is_mine(rid):
+            # request being received (we are the serving side of this rid)
+            st = self._incoming.get(rid)
+            if st is None:
+                return
+            st["body"].append(payload)
+            if flags & F_FIN:
+                body = _unpack(b"".join(st["body"]))
+                writer = StreamWriter()
+                st["writer"] = writer
+                if not st["meta"].get("hs"):
+                    await writer.close()  # no attached stream coming
+                req = Req(body, stream=writer.reader())
+                st["task"] = asyncio.create_task(self._run_handler(rid, st, req))
+            return
+        p = self._pending.get(rid)  # response being received (calling side)
+        if p is None:
+            return
+        p.setdefault("body", []).append(payload)
+        if flags & F_FIN:
+            body = _unpack(b"".join(p["body"]))
+            writer = StreamWriter()
+            p["writer"] = writer
+            meta = p.get("meta", {})
+            fut: asyncio.Future = p["fut"]
+            if meta.get("err"):
+                if not fut.done():
+                    fut.set_exception(RemoteError(meta["err"]))
+                self._pending.pop(rid, None)
+                return
+            if not meta.get("hs"):
+                await writer.close()
+                self._pending.pop(rid, None)
+            if not fut.done():
+                fut.set_result(Resp(body, stream=writer.reader()))
+
+    async def _on_stream(self, rid: int, flags: int, payload: bytes) -> None:
+        if self._rid_is_mine(rid):
+            p = self._pending.get(rid)
+            target = p.get("writer") if p else None
+        else:
+            st = self._incoming.get(rid)
+            target = st.get("writer") if st else None
+        if target is None:
+            return
+        if payload:
+            await target.feed(payload)
+        if flags & F_FIN:
+            await target.close()
+            if self._rid_is_mine(rid):
+                self._pending.pop(rid, None)  # response fully received
+
+    async def _run_handler(self, rid: int, st: dict, req: Req) -> None:
+        meta = st["meta"]
+        try:
+            resp = await self.handler(meta["ep"], self.peer_id, req)
+            rmeta = {
+                "err": None,
+                "hs": resp.stream is not None,
+                "ot": resp.order_tag.to_obj() if resp.order_tag else meta.get("ot"),
+            }
+            frames = _frames_of(
+                K_RESP_META, rid, rmeta, _pack(resp.body), resp.stream
+            )
+        except asyncio.CancelledError:
+            self._incoming.pop(rid, None)
+            return
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            logger.debug("handler error for %s: %r", meta.get("ep"), e)
+            frames = _frames_of(
+                K_RESP_META, rid, {"err": f"{type(e).__name__}: {e}"}, _pack(None), None
+            )
+        await self._enqueue(meta.get("prio", PRIO_NORMAL), frames, rid)
+        self._incoming.pop(rid, None)
+
+    # --- teardown ------------------------------------------------------------
+
+    async def _teardown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for rid, p in list(self._pending.items()):
+            fut = p.get("fut")
+            if fut and not fut.done():
+                fut.set_exception(ConnectionClosed("connection lost"))
+            w = p.get("writer")
+            if w:
+                await w.close("connection lost")
+        self._pending.clear()
+        for rid, st in list(self._incoming.items()):
+            if st.get("task"):
+                st["task"].cancel()
+            if st.get("writer"):
+                await st["writer"].close("connection lost")
+        self._incoming.clear()
+        self._send_wakeup.set()
+        try:
+            self.box.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            self.on_close(self)
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await self._teardown()
+        cur = asyncio.current_task()
+        for t in self._tasks:
+            if t is not cur:
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+
+
+async def _one_frame(kind, flags, rid, payload):
+    yield (kind, flags, rid, payload)

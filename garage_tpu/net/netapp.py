@@ -1,0 +1,185 @@
+"""NetApp: the per-node RPC hub (reference src/net/netapp.rs:65).
+
+Owns the node's ed25519 identity, the TCP listener, the table of named
+endpoints, and the pool of peer connections (one authenticated multiplexed
+connection per peer, dialed lazily and shared).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from .connection import Connection, RemoteError
+from .handshake import HandshakeError, handshake, node_id_of
+from .message import PRIO_NORMAL, Req, Resp
+
+logger = logging.getLogger("garage.net")
+
+
+class RpcError(Exception):
+    pass
+
+
+class Endpoint:
+    """A named RPC endpoint; register a handler or call remote peers."""
+
+    def __init__(self, netapp: "NetApp", path: str):
+        self.netapp = netapp
+        self.path = path
+        self.handler: Callable[[bytes, Req], Awaitable[Resp]] | None = None
+
+    def set_handler(self, fn: Callable[[bytes, Req], Awaitable[Resp]]) -> None:
+        """fn(from_node_id, req) -> resp"""
+        self.handler = fn
+
+    async def call(
+        self,
+        target: bytes,
+        msg: Any,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = 30.0,
+        stream: AsyncIterator[bytes] | None = None,
+        order_tag=None,
+    ) -> Resp:
+        return await self.netapp.call(
+            target, self.path, Req(msg, stream=stream, order_tag=order_tag),
+            prio=prio, timeout=timeout,
+        )
+
+
+class NetApp:
+    def __init__(self, network_key: bytes, node_privkey: bytes):
+        self.network_key = network_key
+        self.node_privkey = node_privkey
+        self.id: bytes = node_id_of(node_privkey)
+        self.endpoints: dict[str, Endpoint] = {}
+        self.conns: dict[bytes, Connection] = {}
+        # every live Connection, including ones displaced from `conns` by a
+        # simultaneous dial in the other direction — needed for shutdown
+        # (Server.wait_closed blocks until all accepted transports close)
+        self.all_conns: set[Connection] = set()
+        self._connecting: dict[bytes, asyncio.Lock] = {}
+        self.server: asyncio.AbstractServer | None = None
+        self.bind_addr: tuple[str, int] | None = None
+        self.on_connected: Callable[[bytes, bool], None] | None = None
+        self.on_disconnected: Callable[[bytes], None] | None = None
+
+    # --- endpoints -----------------------------------------------------------
+
+    def endpoint(self, path: str) -> Endpoint:
+        if path not in self.endpoints:
+            self.endpoints[path] = Endpoint(self, path)
+        return self.endpoints[path]
+
+    async def _dispatch(self, path: str, from_id: bytes, req: Req) -> Resp:
+        ep = self.endpoints.get(path)
+        if ep is None or ep.handler is None:
+            raise RpcError(f"no handler for endpoint {path!r}")
+        return await ep.handler(from_id, req)
+
+    # --- connections ---------------------------------------------------------
+
+    async def listen(self, host: str, port: int) -> None:
+        self.server = await asyncio.start_server(self._accept, host, port)
+        self.bind_addr = (host, self.server.sockets[0].getsockname()[1])
+        logger.info("%s listening on %s:%d", self.id.hex()[:8], host, self.bind_addr[1])
+
+    async def _accept(self, reader, writer) -> None:
+        try:
+            box = await asyncio.wait_for(
+                handshake(
+                    reader, writer, self.network_key, self.node_privkey,
+                    is_server=True,
+                ),
+                timeout=10.0,
+            )
+        except (HandshakeError, asyncio.TimeoutError, OSError, EOFError,
+                asyncio.IncompleteReadError) as e:
+            logger.info("incoming handshake failed: %r", e)
+            writer.close()
+            return
+        conn = Connection(
+            box, self._dispatch, on_close=self._on_conn_close, initiator=False
+        )
+        self._install_conn(conn)
+        if self.on_connected:
+            self.on_connected(box.peer_id, True)
+
+    async def connect(self, addr: tuple[str, int], peer_id: bytes | None = None) -> bytes:
+        """Dial a peer; returns its node id.  Reuses an existing connection."""
+        if peer_id is not None and peer_id in self.conns:
+            return peer_id
+        lock = self._connecting.setdefault(peer_id or b"?" + repr(addr).encode(), asyncio.Lock())
+        async with lock:
+            if peer_id is not None and peer_id in self.conns:
+                return peer_id
+            reader, writer = await asyncio.open_connection(addr[0], addr[1])
+            try:
+                box = await asyncio.wait_for(
+                    handshake(
+                        reader, writer, self.network_key, self.node_privkey,
+                        is_server=False, expected_peer_id=peer_id,
+                    ),
+                    timeout=10.0,
+                )
+            except BaseException:
+                writer.close()
+                raise
+            conn = Connection(
+                box, self._dispatch, on_close=self._on_conn_close, initiator=True
+            )
+            self._install_conn(conn)
+            if self.on_connected:
+                self.on_connected(box.peer_id, False)
+            return box.peer_id
+
+    def _install_conn(self, conn: Connection) -> None:
+        old = self.conns.get(conn.peer_id)
+        self.conns[conn.peer_id] = conn
+        self.all_conns.add(conn)
+        conn.start()
+        if old is not None:
+            # displaced by a reconnect or simultaneous dial: close the old
+            # connection so its socket and tasks don't leak
+            asyncio.create_task(old.close())
+
+    def _on_conn_close(self, conn: Connection) -> None:
+        self.all_conns.discard(conn)
+        cur = self.conns.get(conn.peer_id)
+        if cur is conn:
+            del self.conns[conn.peer_id]
+            if self.on_disconnected:
+                self.on_disconnected(conn.peer_id)
+
+    def is_connected(self, peer_id: bytes) -> bool:
+        return peer_id in self.conns
+
+    async def call(
+        self,
+        target: bytes,
+        path: str,
+        req: Req,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = 30.0,
+    ) -> Resp:
+        if target == self.id:
+            # local shortcut (reference calls local handlers directly too)
+            return await self._dispatch(path, self.id, req)
+        conn = self.conns.get(target)
+        if conn is None:
+            raise RpcError(f"not connected to {target.hex()[:16]}")
+        return await conn.call(path, req, prio=prio, timeout=timeout)
+
+    async def shutdown(self) -> None:
+        # close connections first: Server.wait_closed (3.12+) blocks until
+        # every accepted transport has disconnected
+        for conn in list(self.all_conns):
+            await conn.close()
+        if self.server:
+            self.server.close()
+            await self.server.wait_closed()
+
+
+__all__ = ["NetApp", "Endpoint", "RpcError", "RemoteError"]

@@ -1,0 +1,193 @@
+"""Full-mesh peering with ping-based failure detection.
+
+Reference src/net/peering.rs:23-50: every node tries to keep a connection
+to every known peer; pings every PING_INTERVAL, a peer is DOWN after
+FAILED_PING_THRESHOLD consecutive misses; peer lists are exchanged so the
+mesh closes transitively.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+
+from .message import PRIO_HIGH, Req, Resp
+from .netapp import NetApp
+
+logger = logging.getLogger("garage.peering")
+
+PING_INTERVAL = 15.0
+FAILED_PING_THRESHOLD = 4
+PING_TIMEOUT = 10.0
+CONNECT_RETRY_BASE = 1.0
+CONNECT_RETRY_MAX = 60.0
+
+
+@dataclass
+class PeerInfo:
+    id: bytes
+    addr: tuple[str, int] | None = None
+    state: str = "new"  # new | connecting | up | down
+    last_seen: float = 0.0
+    ping_rtt: float | None = None
+    failed_pings: int = 0
+    connect_failures: int = 0
+    next_retry: float = 0.0
+    rtts: list[float] = field(default_factory=list)
+
+
+class PeeringManager:
+    def __init__(
+        self,
+        netapp: NetApp,
+        bootstrap: list[tuple[bytes, tuple[str, int]]],
+        public_addr: tuple[str, int] | None = None,
+    ):
+        self.netapp = netapp
+        # the address advertised to peers: a 0.0.0.0/:: bind address is not
+        # dialable, so deployments must set rpc_public_addr (reference
+        # config.rs rpc_public_addr); defaults to the bind address, which
+        # is fine for loopback dev clusters and tests
+        self.public_addr = public_addr
+        self.peers: dict[bytes, PeerInfo] = {}
+        for pid, addr in bootstrap:
+            if pid != netapp.id:
+                self.peers[pid] = PeerInfo(id=pid, addr=addr)
+        self.ping_ep = netapp.endpoint("net/ping")
+        self.ping_ep.set_handler(self._handle_ping)
+        self.peerlist_ep = netapp.endpoint("net/peer_list")
+        self.peerlist_ep.set_handler(self._handle_peer_list)
+        netapp.on_connected = self._on_connected
+        netapp.on_disconnected = self._on_disconnected
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    # --- handlers ------------------------------------------------------------
+
+    async def _handle_ping(self, from_id: bytes, req: Req) -> Resp:
+        return Resp(req.body)  # echo nonce
+
+    async def _handle_peer_list(self, from_id: bytes, req: Req) -> Resp:
+        self._learn(req.body or [])
+        return Resp(self._known_list())
+
+    def _known_list(self) -> list:
+        my_addr = self.public_addr or self.netapp.bind_addr or ("", 0)
+        out = [[self.netapp.id, list(my_addr)]]
+        for p in self.peers.values():
+            if p.addr:
+                out.append([p.id, list(p.addr)])
+        return out
+
+    def _learn(self, peer_list) -> None:
+        for item in peer_list:
+            pid, addr = bytes(item[0]), (item[1][0], int(item[1][1]))
+            if pid == self.netapp.id:
+                continue
+            if pid not in self.peers:
+                self.peers[pid] = PeerInfo(id=pid, addr=addr)
+            elif self.peers[pid].addr is None:
+                self.peers[pid].addr = addr
+
+    def _on_connected(self, pid: bytes, incoming: bool) -> None:
+        info = self.peers.setdefault(pid, PeerInfo(id=pid))
+        info.state = "up"
+        info.last_seen = time.monotonic()
+        info.failed_pings = 0
+        info.connect_failures = 0
+
+    def _on_disconnected(self, pid: bytes) -> None:
+        if pid in self.peers:
+            self.peers[pid].state = "down"
+
+    # --- main loop -----------------------------------------------------------
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self._tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                logger.warning("peering tick error: %r", e)
+            await asyncio.sleep(1.0)
+
+    async def _tick(self) -> None:
+        now = time.monotonic()
+        for p in list(self.peers.values()):
+            if self.netapp.is_connected(p.id):
+                if now - p.last_seen >= PING_INTERVAL:
+                    asyncio.create_task(self._ping(p))
+            elif p.addr and now >= p.next_retry:
+                p.state = "connecting"
+                asyncio.create_task(self._try_connect(p))
+
+    async def _ping(self, p: PeerInfo) -> None:
+        p.last_seen = time.monotonic()  # don't double-ping while in flight
+        nonce = random.getrandbits(63)
+        t0 = time.monotonic()
+        try:
+            resp = await self.ping_ep.call(
+                p.id, nonce, prio=PRIO_HIGH, timeout=PING_TIMEOUT
+            )
+            if resp.body != nonce:
+                raise ValueError("ping nonce mismatch")
+            p.ping_rtt = time.monotonic() - t0
+            p.rtts = (p.rtts + [p.ping_rtt])[-16:]
+            p.failed_pings = 0
+            p.state = "up"
+            # piggyback peer-list exchange on successful pings
+            resp = await self.peerlist_ep.call(
+                p.id, self._known_list(), prio=PRIO_HIGH, timeout=PING_TIMEOUT
+            )
+            self._learn(resp.body or [])
+        except Exception:  # noqa: BLE001
+            p.failed_pings += 1
+            if p.failed_pings >= FAILED_PING_THRESHOLD:
+                p.state = "down"
+                conn = self.netapp.conns.get(p.id)
+                if conn:
+                    await conn.close()
+
+    async def _try_connect(self, p: PeerInfo) -> None:
+        try:
+            await self.netapp.connect(p.addr, p.id)
+        except Exception as e:  # noqa: BLE001
+            p.connect_failures += 1
+            p.state = "down"
+            delay = min(
+                CONNECT_RETRY_MAX,
+                CONNECT_RETRY_BASE * (2 ** min(p.connect_failures, 6)),
+            ) * (0.75 + random.random() / 2)
+            p.next_retry = time.monotonic() + delay
+            logger.debug("connect to %s failed: %r", p.id.hex()[:8], e)
+
+    # --- introspection --------------------------------------------------------
+
+    def peer_avg_rtt(self, pid: bytes) -> float | None:
+        p = self.peers.get(pid)
+        if p and p.rtts:
+            return sum(p.rtts) / len(p.rtts)
+        return None
+
+    def connected_peers(self) -> list[bytes]:
+        return [pid for pid in self.peers if self.netapp.is_connected(pid)]
+
+    def peer_states(self) -> dict[bytes, str]:
+        return {
+            pid: ("up" if self.netapp.is_connected(pid) else p.state)
+            for pid, p in self.peers.items()
+        }

@@ -15,7 +15,7 @@ from __future__ import annotations
 import copy
 from typing import Any, Callable, Generic, Iterator, TypeVar
 
-import msgpack
+from .serde import pack as _serde_pack
 
 T = TypeVar("T")
 
@@ -24,7 +24,7 @@ def _ord_key(v: Any) -> bytes:
     """Deterministic total order for tie-breaking, same on all nodes."""
     if isinstance(v, Crdt):
         v = v.to_obj()
-    return msgpack.packb(v, use_bin_type=True)
+    return _serde_pack(v)
 
 
 def _adopt(v: Any) -> Any:

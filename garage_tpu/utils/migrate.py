@@ -24,17 +24,9 @@ from __future__ import annotations
 
 from typing import Any, TypeVar
 
-import msgpack
+from .serde import pack, unpack  # noqa: F401 — canonical encoding, re-exported
 
 M = TypeVar("M", bound="Migratable")
-
-
-def pack(obj: Any) -> bytes:
-    return msgpack.packb(obj, use_bin_type=True)
-
-
-def unpack(data: bytes) -> Any:
-    return msgpack.unpackb(data, raw=False, strict_map_key=False, use_list=True)
 
 
 class Migratable:

@@ -1,0 +1,320 @@
+"""net layer: in-process multi-node mesh tests (reference src/net/test.rs
+pattern: several NetApp+PeeringManager instances on localhost ports inside
+one event loop), plus handshake security and stream/QoS behavior."""
+
+import asyncio
+import os
+
+import pytest
+
+from garage_tpu.net import NetApp, PRIO_BACKGROUND, PRIO_HIGH
+from garage_tpu.net.connection import RemoteError
+from garage_tpu.net.handshake import HandshakeError, gen_node_key, node_id_of
+from garage_tpu.net.message import Req, Resp
+from garage_tpu.net.peering import PeeringManager
+from garage_tpu.net.stream import bytes_stream, read_stream_to_end
+
+NETKEY = b"n" * 32
+
+
+async def make_node(netkey=NETKEY):
+    app = NetApp(netkey, gen_node_key())
+    await app.listen("127.0.0.1", 0)
+    return app
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_basic_call_roundtrip():
+    async def main():
+        a, b = await make_node(), await make_node()
+        ep = b.endpoint("test/echo")
+        from_ids = []
+
+        async def handler(from_id, req):
+            from_ids.append(from_id)
+            return Resp({"echo": req.body, "n": req.body["n"] + 1})
+
+        ep.set_handler(handler)
+        await a.connect(b.bind_addr, b.id)
+        resp = await a.endpoint("test/echo").call(b.id, {"n": 41})
+        assert resp.body["n"] == 42
+        assert from_ids == [a.id], "remote call must carry the caller's node id"
+        # local shortcut: a node can call its own endpoints
+        b_resp = await b.endpoint("test/echo").call(b.id, {"n": 1})
+        assert b_resp.body["n"] == 2
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_remote_error_propagates():
+    async def main():
+        a, b = await make_node(), await make_node()
+
+        async def handler(from_id, req):
+            raise ValueError("deliberate")
+
+        b.endpoint("test/fail").set_handler(handler)
+        await a.connect(b.bind_addr, b.id)
+        with pytest.raises(RemoteError, match="deliberate"):
+            await a.endpoint("test/fail").call(b.id, None)
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_large_body_and_stream():
+    async def main():
+        a, b = await make_node(), await make_node()
+        blob = os.urandom(300 * 1024)  # forces multi-chunk body
+
+        async def handler(from_id, req):
+            got = await read_stream_to_end(req.stream)
+            return Resp(
+                {"body_len": len(req.body), "stream_len": len(got)},
+                stream=bytes_stream(got[::-1]),
+            )
+
+        b.endpoint("test/stream").set_handler(handler)
+        await a.connect(b.bind_addr, b.id)
+        resp = await a.endpoint("test/stream").call(
+            b.id, "x" * 100_000, stream=bytes_stream(blob), timeout=30
+        )
+        assert resp.body == {"body_len": 100_000, "stream_len": len(blob)}
+        back = await read_stream_to_end(resp.stream)
+        assert back == blob[::-1]
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_wrong_network_key_rejected():
+    async def main():
+        a = await make_node(netkey=b"a" * 32)
+        b = await make_node(netkey=b"b" * 32)
+        with pytest.raises((HandshakeError, asyncio.IncompleteReadError, ConnectionError)):
+            await a.connect(b.bind_addr, b.id)
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_peer_id_pinning():
+    async def main():
+        a, b = await make_node(), await make_node()
+        wrong_id = node_id_of(gen_node_key())
+        with pytest.raises(HandshakeError, match="peer id mismatch"):
+            await a.connect(b.bind_addr, wrong_id)
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_three_node_mesh_converges():
+    """a knows b, b knows c: peer-list exchange must close the mesh so a
+    discovers and connects to c (reference net/test.rs:15-44)."""
+
+    async def main():
+        a, b, c = await make_node(), await make_node(), await make_node()
+        pa = PeeringManager(a, [(b.id, b.bind_addr)])
+        pb = PeeringManager(b, [(c.id, c.bind_addr)])
+        pc = PeeringManager(c, [])
+        # speed up the test: ping every 0.2s
+        import garage_tpu.net.peering as peering_mod
+
+        old = peering_mod.PING_INTERVAL
+        peering_mod.PING_INTERVAL = 0.2
+        try:
+            for p in (pa, pb, pc):
+                p.start()
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if (
+                    set(pa.connected_peers()) == {b.id, c.id}
+                    and set(pb.connected_peers()) == {a.id, c.id}
+                    and set(pc.connected_peers()) == {a.id, b.id}
+                ):
+                    break
+            assert set(pa.connected_peers()) == {b.id, c.id}, "a not fully meshed"
+            assert set(pb.connected_peers()) == {a.id, c.id}, "b not fully meshed"
+            assert set(pc.connected_peers()) == {a.id, b.id}, "c not fully meshed"
+            assert pa.peer_avg_rtt(b.id) is not None
+        finally:
+            peering_mod.PING_INTERVAL = old
+            for p in (pa, pb, pc):
+                await p.stop()
+            for n in (a, b, c):
+                await n.shutdown()
+
+    run(main())
+
+
+def test_priority_qos_interleaving():
+    """A HIGH-priority call issued while a huge BACKGROUND body is in
+    flight must complete long before the background transfer finishes."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+        order = []
+
+        async def big_handler(from_id, req):
+            order.append("big_done")
+            return Resp(len(req.body))
+
+        async def small_handler(from_id, req):
+            order.append("small_done")
+            return Resp("pong")
+
+        b.endpoint("test/big").set_handler(big_handler)
+        b.endpoint("test/small").set_handler(small_handler)
+        await a.connect(b.bind_addr, b.id)
+
+        big_len = 32 * 1024 * 1024  # ~2048 chunks: in flight for a while
+        big = asyncio.create_task(
+            a.endpoint("test/big").call(
+                b.id, "z" * big_len, prio=PRIO_BACKGROUND, timeout=120
+            )
+        )
+        await asyncio.sleep(0.01)  # let the big transfer start
+        small = await a.endpoint("test/small").call(
+            b.id, "ping", prio=PRIO_HIGH, timeout=10
+        )
+        assert small.body == "pong"
+        big_resp = await big
+        assert big_resp.body == big_len
+        assert order[0] == "small_done", f"QoS violated: {order}"
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_timeout_cancels():
+    async def main():
+        a, b = await make_node(), await make_node()
+
+        async def slow(from_id, req):
+            await asyncio.sleep(5)
+            return Resp("late")
+
+        b.endpoint("test/slow").set_handler(slow)
+        await a.connect(b.bind_addr, b.id)
+        with pytest.raises(asyncio.TimeoutError):
+            await a.endpoint("test/slow").call(b.id, None, timeout=0.3)
+        # connection still usable afterwards
+        b.endpoint("test/ok").set_handler(lambda f, r: _resp_ok())
+        resp = await a.endpoint("test/ok").call(b.id, None, timeout=5)
+        assert resp.body == "ok"
+        await a.shutdown()
+        await b.shutdown()
+
+    async def _resp_ok():
+        return Resp("ok")
+
+    run(main())
+
+
+def test_bidirectional_concurrent_calls():
+    """Both peers call each other simultaneously: request ids must not
+    collide between directions (dialer odd / accepter even)."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+
+        async def mk_handler(tag):
+            async def h(from_id, req):
+                await asyncio.sleep(0.05)  # force overlap
+                return Resp([tag, req.body])
+
+            return h
+
+        a.endpoint("t/x").set_handler(await mk_handler("a"))
+        b.endpoint("t/x").set_handler(await mk_handler("b"))
+        await a.connect(b.bind_addr, b.id)
+        results = await asyncio.gather(
+            *[a.endpoint("t/x").call(b.id, i) for i in range(5)],
+            *[b.endpoint("t/x").call(a.id, 100 + i) for i in range(5)],
+        )
+        assert [r.body for r in results[:5]] == [["b", i] for i in range(5)]
+        assert [r.body for r in results[5:]] == [["a", 100 + i] for i in range(5)]
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_abandoned_stream_does_not_stall_connection():
+    """A caller that never reads a response stream must not wedge the recv
+    loop for other multiplexed RPCs."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+        blob = os.urandom(2 * 1024 * 1024)
+
+        async def streamer(from_id, req):
+            return Resp("here", stream=bytes_stream(blob))
+
+        async def pong(from_id, req):
+            return Resp("pong")
+
+        b.endpoint("t/stream").set_handler(streamer)
+        b.endpoint("t/pong").set_handler(pong)
+        await a.connect(b.bind_addr, b.id)
+        resp = await a.endpoint("t/stream").call(b.id, None)
+        assert resp.body == "here"  # stream deliberately never consumed
+        for _ in range(3):
+            r = await a.endpoint("t/pong").call(b.id, None, timeout=5)
+            assert r.body == "pong"
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_stream_producer_failure_unblocks_peer():
+    """If the sender's stream producer raises mid-transfer, the receiving
+    handler must get a stream error instead of hanging forever."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+        handler_result = asyncio.get_event_loop().create_future()
+
+        async def h(from_id, req):
+            try:
+                await read_stream_to_end(req.stream)
+                handler_result.set_result("completed")
+            except BaseException as e:  # StreamError or CancelledError
+                if not handler_result.done():
+                    handler_result.set_result(f"error: {type(e).__name__}")
+                raise
+            return Resp("ok")
+
+        b.endpoint("t/sink").set_handler(h)
+        await a.connect(b.bind_addr, b.id)
+
+        async def bad_producer():
+            yield b"x" * 50_000
+            await asyncio.sleep(0.3)  # let the peer's handler start reading
+            raise RuntimeError("producer died")
+
+        with pytest.raises(RuntimeError, match="producer died"):
+            await a.endpoint("t/sink").call(b.id, None, stream=bad_producer(), timeout=5)
+        got = await asyncio.wait_for(handler_result, 5)
+        assert got.startswith("error"), f"handler saw: {got}"
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
