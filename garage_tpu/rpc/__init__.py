@@ -1,0 +1,1 @@
+"""Cluster membership, layout and quorum RPC (reference src/rpc/)."""

@@ -1,0 +1,37 @@
+"""Replication factor, consistency mode and quorum arithmetic.
+
+Reference src/rpc/replication_mode.rs:8-59:
+  read_quorum  = ceil(rf/2)   (degraded/dangerous read 1)
+  write_quorum = rf + 1 - read_quorum   (dangerous writes 1)
+so read_quorum + write_quorum = rf + 1 > rf (read-your-writes).
+RF=3 consistent => read 2 / write 2; RF=2 => read 1 / write 2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ReplicationMode:
+    replication_factor: int
+    consistency_mode: str = "consistent"  # consistent | degraded | dangerous
+
+    def __post_init__(self):
+        if self.replication_factor < 1:
+            raise ValueError("replication_factor must be >= 1")
+        if self.consistency_mode not in ("consistent", "degraded", "dangerous"):
+            raise ValueError(f"bad consistency mode {self.consistency_mode!r}")
+
+    def read_quorum(self) -> int:
+        if self.consistency_mode == "consistent":
+            return (self.replication_factor + 1) // 2
+        return 1  # degraded | dangerous
+
+    def write_quorum(self) -> int:
+        if self.consistency_mode == "dangerous":
+            return 1
+        return self.replication_factor + 1 - self.read_quorum()
+
+    def is_read_after_write_consistent(self) -> bool:
+        return self.read_quorum() + self.write_quorum() > self.replication_factor

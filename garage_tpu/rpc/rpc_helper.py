@@ -1,0 +1,229 @@
+"""Quorum RPC strategies (reference src/rpc/rpc_helper.rs:128-533).
+
+  call / call_many / broadcast — plain fan-out
+  try_call_many — parallel calls until `quorum` successes; either
+      all-at-once (writes) or preference-ordered staggered sends (reads:
+      self > lowest observed rtt, reference rpc_helper.rs:621)
+  try_write_many_sets — during layout transitions a write must reach a
+      quorum in EVERY active layout version's node set; leftover requests
+      keep running in the background so slow nodes still converge
+      (reference rpc_helper.rs:432-533)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from ..net.message import PRIO_NORMAL
+from ..net.netapp import Endpoint
+from ..utils.background import spawn
+from ..utils.error import Quorum
+
+logger = logging.getLogger("garage.rpc")
+
+STAGGER_DELAY = 0.2  # launch an extra request if no reply within this
+
+
+class RpcHelper:
+    def __init__(self, our_id: bytes, peering, default_timeout: float = 30.0):
+        self.our_id = our_id
+        self.peering = peering
+        self.default_timeout = default_timeout
+
+    # --- ordering ------------------------------------------------------------
+
+    def request_order(self, nodes: list[bytes]) -> list[bytes]:
+        """Self first, then nodes by ascending observed ping rtt
+        (reference rpc_helper.rs:621-)."""
+
+        def key(n: bytes):
+            if n == self.our_id:
+                return (0, 0.0, n)
+            rtt = self.peering.peer_avg_rtt(n)
+            return (1, rtt if rtt is not None else 9.0, n)
+
+        return sorted(nodes, key=key)
+
+    # --- basic ---------------------------------------------------------------
+
+    async def call(
+        self,
+        endpoint: Endpoint,
+        node: bytes,
+        msg: Any,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = None,
+    ):
+        return await endpoint.call(
+            node, msg, prio=prio, timeout=timeout or self.default_timeout
+        )
+
+    async def call_many(
+        self,
+        endpoint: Endpoint,
+        nodes: list[bytes],
+        msg: Any,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = None,
+    ) -> list[tuple[bytes, Any]]:
+        """Call all nodes; returns [(node, Resp | Exception)]."""
+
+        async def one(n):
+            try:
+                return (n, await self.call(endpoint, n, msg, prio, timeout))
+            except Exception as e:  # noqa: BLE001
+                return (n, e)
+
+        return list(await asyncio.gather(*[one(n) for n in nodes]))
+
+    async def broadcast(self, endpoint: Endpoint, msg: Any, prio=PRIO_NORMAL):
+        nodes = [self.our_id] + list(self.peering.connected_peers())
+        return await self.call_many(endpoint, nodes, msg, prio)
+
+    # --- quorum reads/writes --------------------------------------------------
+
+    async def try_call_many(
+        self,
+        endpoint: Endpoint,
+        nodes: list[bytes],
+        msg: Any,
+        quorum: int,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = None,
+        all_at_once: bool = True,
+    ) -> list[Any]:
+        """Returns the first `quorum` successful response bodies, or raises
+        `Quorum`.  With all_at_once=False, requests are launched in
+        preference order, staggering extras only when replies are slow —
+        the read path optimization that keeps traffic off far nodes."""
+        nodes = self.request_order(nodes)
+        if quorum > len(nodes):
+            raise Quorum(quorum, 0, [f"only {len(nodes)} candidate nodes"])
+        timeout = timeout or self.default_timeout
+
+        results: list[Any] = []
+        errors: list[str] = []
+        pending: set[asyncio.Task] = set()
+        next_idx = 0
+
+        def launch(n: bytes):
+            async def one():
+                return await self.call(endpoint, n, msg, prio, timeout)
+
+            t = asyncio.create_task(one())
+            t.node = n  # type: ignore[attr-defined]
+            pending.add(t)
+
+        initial = len(nodes) if all_at_once else quorum
+        for n in nodes[:initial]:
+            launch(n)
+        next_idx = initial
+
+        try:
+            while len(results) < quorum:
+                if not pending:
+                    raise Quorum(quorum, len(results), errors)
+                wait_timeout = None if all_at_once else STAGGER_DELAY
+                done, _ = await asyncio.wait(
+                    pending,
+                    timeout=wait_timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done and next_idx < len(nodes):
+                    # slow: stagger one more request
+                    launch(nodes[next_idx])
+                    next_idx += 1
+                    continue
+                for t in done:
+                    pending.discard(t)
+                    try:
+                        results.append(t.result())
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{t.node.hex()[:8]}: {e!r}")  # type: ignore[attr-defined]
+                        if next_idx < len(nodes):
+                            launch(nodes[next_idx])
+                            next_idx += 1
+            return results[:quorum]
+        finally:
+            if pending:
+                if all_at_once:
+                    # write path: surplus requests keep running so slow
+                    # replicas still receive the update (reference
+                    # rpc_helper.rs non-interrupting strategy)
+                    spawn(_drain(pending))
+                else:
+                    # read path: extra reads are pure cost, cancel them
+                    for t in pending:
+                        t.cancel()
+
+    async def try_write_many_sets(
+        self,
+        endpoint: Endpoint,
+        write_sets: list[list[bytes]],
+        msg: Any,
+        quorum: int,
+        prio: int = PRIO_NORMAL,
+        timeout: float | None = None,
+    ) -> None:
+        """Write to the union of all sets; success when EVERY set has
+        `quorum` successes.  Remaining in-flight requests are left running
+        in the background (they still deliver the write to slow nodes)."""
+        timeout = timeout or self.default_timeout
+        if not write_sets or all(not s for s in write_sets):
+            raise Quorum(quorum, 0, ["no write sets (layout has no nodes yet)"])
+        all_nodes: list[bytes] = []
+        for s in write_sets:
+            for n in s:
+                if n not in all_nodes:
+                    all_nodes.append(n)
+        set_success = [0] * len(write_sets)
+        set_failed = [0] * len(write_sets)
+        errors: list[str] = []
+        done_ev = asyncio.Event()
+
+        def sets_satisfied() -> bool:
+            return all(
+                s >= min(quorum, len(write_sets[i]))
+                for i, s in enumerate(set_success)
+            )
+
+        def sets_hopeless() -> bool:
+            return any(
+                len(write_sets[i]) - set_failed[i] < min(quorum, len(write_sets[i]))
+                for i in range(len(write_sets))
+            )
+
+        async def one(n: bytes):
+            try:
+                await self.call(endpoint, n, msg, prio, timeout)
+                for i, s in enumerate(write_sets):
+                    if n in s:
+                        set_success[i] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{n.hex()[:8]}: {e!r}")
+                for i, s in enumerate(write_sets):
+                    if n in s:
+                        set_failed[i] += 1
+            if sets_satisfied() or sets_hopeless():
+                done_ev.set()
+
+        tasks = [asyncio.create_task(one(n)) for n in all_nodes]
+        try:
+            await asyncio.wait_for(done_ev.wait(), timeout + 5.0)
+        except asyncio.TimeoutError:
+            pass
+        if not sets_satisfied():
+            for t in tasks:
+                t.cancel()
+            got = min(set_success) if set_success else 0
+            raise Quorum(quorum, got, errors)
+        # leftover requests continue in the background
+        leftover = [t for t in tasks if not t.done()]
+        if leftover:
+            spawn(_drain(leftover))
+
+
+async def _drain(tasks):
+    await asyncio.gather(*tasks, return_exceptions=True)

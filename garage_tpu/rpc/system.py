@@ -1,0 +1,286 @@
+"""System: cluster membership, status gossip, health.
+
+Reference src/rpc/system.rs:87-179: persists the peer list, exchanges
+`NodeStatus` (hostname, version, layout digest, disk space) with all
+connected peers every STATUS_EXCHANGE_INTERVAL, runs a discovery loop over
+bootstrap peers, pulls/advertises cluster layouts when digests differ, and
+computes `ClusterHealth` from per-partition quorum availability.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import shutil
+import socket
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from ..net.message import PRIO_HIGH, Req, Resp
+from ..utils.background import spawn
+from ..net.netapp import NetApp
+from ..net.peering import PeeringManager
+from ..utils.migrate import Migratable
+from .layout.manager import LayoutManager
+from .layout.types import N_PARTITIONS
+from .replication_mode import ReplicationMode
+
+logger = logging.getLogger("garage.system")
+
+STATUS_EXCHANGE_INTERVAL = 10.0
+DISCOVERY_INTERVAL = 60.0
+
+
+@dataclass
+class NodeStatus:
+    hostname: str
+    version: str
+    layout_digest: bytes
+    meta_disk_avail: tuple[int, int] | None = None  # (free, total)
+    data_disk_avail: tuple[int, int] | None = None
+    replication_factor: int = 1
+
+    def to_obj(self) -> Any:
+        return {
+            "h": self.hostname,
+            "v": self.version,
+            "ld": self.layout_digest,
+            "md": list(self.meta_disk_avail) if self.meta_disk_avail else None,
+            "dd": list(self.data_disk_avail) if self.data_disk_avail else None,
+            "rf": self.replication_factor,
+        }
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "NodeStatus":
+        return cls(
+            hostname=obj["h"],
+            version=obj["v"],
+            layout_digest=bytes(obj["ld"]),
+            meta_disk_avail=tuple(obj["md"]) if obj.get("md") else None,
+            data_disk_avail=tuple(obj["dd"]) if obj.get("dd") else None,
+            replication_factor=obj.get("rf", 1),
+        )
+
+
+@dataclass
+class ClusterHealth:
+    status: str  # healthy | degraded | unavailable
+    known_nodes: int = 0
+    connected_nodes: int = 0
+    storage_nodes: int = 0
+    storage_nodes_up: int = 0
+    partitions: int = N_PARTITIONS
+    partitions_quorum: int = 0
+    partitions_all_ok: int = 0
+
+
+class PersistedPeers(Migratable):
+    VERSION_MARKER = b"GT0peers"
+
+    def __init__(self, peers: list[tuple[bytes, tuple[str, int]]]):
+        self.peers = peers
+
+    def to_obj(self) -> Any:
+        return [[p, list(a)] for p, a in self.peers]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "PersistedPeers":
+        return cls([(bytes(p), (a[0], int(a[1]))) for p, a in obj])
+
+
+class System:
+    """Composition of NetApp + PeeringManager + LayoutManager + gossip."""
+
+    def __init__(
+        self,
+        netapp: NetApp,
+        layout_manager: LayoutManager,
+        replication_mode: ReplicationMode,
+        bootstrap: list[tuple[bytes, tuple[str, int]]] | None = None,
+        peer_persister=None,
+        metadata_dir: str | None = None,
+        data_dirs: list[str] | None = None,
+        public_addr: tuple[str, int] | None = None,
+    ):
+        self.netapp = netapp
+        self.id = netapp.id
+        self.layout_manager = layout_manager
+        self.replication_mode = replication_mode
+        self.peer_persister = peer_persister
+        self.metadata_dir = metadata_dir
+        self.data_dirs = data_dirs or []
+        persisted = peer_persister.load() if peer_persister else None
+        known = list(bootstrap or [])
+        if persisted:
+            known.extend(persisted.peers)
+        self.peering = PeeringManager(netapp, known, public_addr=public_addr)
+        self.node_status: dict[bytes, tuple[NodeStatus, float]] = {}
+        self._tasks: list[asyncio.Task] = []
+
+        self.status_ep = netapp.endpoint("rpc/system/status")
+        self.status_ep.set_handler(self._handle_status)
+        self.pull_layout_ep = netapp.endpoint("rpc/system/pull_layout")
+        self.pull_layout_ep.set_handler(self._handle_pull_layout)
+        self.adv_layout_ep = netapp.endpoint("rpc/system/advertise_layout")
+        self.adv_layout_ep.set_handler(self._handle_advertise_layout)
+        layout_manager.subscribe(self._on_layout_change)
+
+    # --- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        self.peering.start()
+        self._tasks.append(asyncio.create_task(self._status_loop()))
+        self._tasks.append(asyncio.create_task(self._discovery_loop()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.peering.stop()
+
+    # --- status --------------------------------------------------------------
+
+    def local_status(self) -> NodeStatus:
+        def disk(path):
+            try:
+                u = shutil.disk_usage(path)
+                return (u.free, u.total)
+            except OSError:
+                return None
+
+        return NodeStatus(
+            hostname=socket.gethostname(),
+            version="garage-tpu/0.1.0",
+            layout_digest=self.layout_manager.digest(),
+            meta_disk_avail=disk(self.metadata_dir) if self.metadata_dir else None,
+            data_disk_avail=disk(self.data_dirs[0]) if self.data_dirs else None,
+            replication_factor=self.replication_mode.replication_factor,
+        )
+
+    async def _handle_status(self, from_id: bytes, req: Req) -> Resp:
+        st = NodeStatus.from_obj(req.body)
+        self._record_status(from_id, st)
+        return Resp(self.local_status().to_obj())
+
+    def _record_status(self, from_id: bytes, st: NodeStatus) -> None:
+        self.node_status[from_id] = (st, time.monotonic())
+        if st.layout_digest != self.layout_manager.digest():
+            spawn(self._pull_layout_from(from_id))
+
+    async def _pull_layout_from(self, node: bytes) -> None:
+        try:
+            resp = await self.pull_layout_ep.call(node, None, prio=PRIO_HIGH)
+            if resp.body is not None:
+                self.layout_manager.merge_remote(resp.body)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("layout pull from %s failed: %r", node.hex()[:8], e)
+
+    async def _handle_pull_layout(self, from_id: bytes, req: Req) -> Resp:
+        return Resp(self.layout_manager.history.to_obj())
+
+    async def _handle_advertise_layout(self, from_id: bytes, req: Req) -> Resp:
+        self.layout_manager.merge_remote(req.body)
+        return Resp(None)
+
+    def _on_layout_change(self) -> None:
+        # broadcast the merged layout to all connected peers (gossip)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        obj = self.layout_manager.history.to_obj()
+        for pid in self.peering.connected_peers():
+            spawn(self._advertise_to(pid, obj))
+
+    async def _advertise_to(self, pid: bytes, obj: Any) -> None:
+        try:
+            await self.adv_layout_ep.call(pid, obj, prio=PRIO_HIGH)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("layout advertise to %s failed: %r", pid.hex()[:8], e)
+
+    # --- loops ---------------------------------------------------------------
+
+    async def _status_loop(self) -> None:
+        while True:
+            try:
+                st = self.local_status().to_obj()
+
+                async def exchange(pid):
+                    try:
+                        resp = await self.status_ep.call(
+                            pid, st, prio=PRIO_HIGH, timeout=10.0
+                        )
+                        self._record_status(pid, NodeStatus.from_obj(resp.body))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+                # concurrent fan-out: one hung peer must not delay the rest
+                await asyncio.gather(
+                    *[exchange(pid) for pid in self.peering.connected_peers()]
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("status loop error")
+            await asyncio.sleep(STATUS_EXCHANGE_INTERVAL)
+
+    async def _discovery_loop(self) -> None:
+        while True:
+            try:
+                if self.peer_persister:
+                    peers = [
+                        (p.id, p.addr)
+                        for p in self.peering.peers.values()
+                        if p.addr is not None
+                    ]
+                    self.peer_persister.save(PersistedPeers(peers))
+            except Exception:  # noqa: BLE001
+                logger.exception("discovery loop error")
+            await asyncio.sleep(DISCOVERY_INTERVAL)
+
+    # --- health --------------------------------------------------------------
+
+    def health(self) -> ClusterHealth:
+        layout = self.layout_manager.history
+        storage_nodes = layout.all_storage_nodes()
+        up = {
+            n
+            for n in storage_nodes
+            if n == self.id or self.netapp.is_connected(n)
+        }
+        quorum = self.replication_mode.write_quorum()
+        n_quorum = n_all = 0
+        cur = layout.current()
+        if cur.ring_assignment:
+            for p in range(N_PARTITIONS):
+                nodes = set(cur.nodes_of_partition(p))
+                # during migration a partition must be writable in every
+                # active version's node set
+                ok_all = all(
+                    sum(1 for n in v.nodes_of_partition(p) if n in up) >= quorum
+                    for v in layout.versions
+                    if v.ring_assignment
+                )
+                if ok_all:
+                    n_quorum += 1
+                if nodes <= up:
+                    n_all += 1
+        status = "healthy"
+        if cur.ring_assignment:
+            if n_quorum < N_PARTITIONS:
+                status = "unavailable"
+            elif n_all < N_PARTITIONS or len(up) < len(storage_nodes):
+                status = "degraded"
+        known = self.peering.peers
+        return ClusterHealth(
+            status=status,
+            known_nodes=len(known) + 1,
+            connected_nodes=len(self.peering.connected_peers()) + 1,
+            storage_nodes=len(storage_nodes),
+            storage_nodes_up=len(up),
+            partitions_quorum=n_quorum,
+            partitions_all_ok=n_all,
+        )

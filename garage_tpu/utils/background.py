@@ -23,6 +23,18 @@ logger = logging.getLogger("garage.background")
 
 EXIT_DEADLINE_SEC = 8.0
 
+# The event loop only keeps weak references to tasks; fire-and-forget tasks
+# must be anchored somewhere or they can be garbage-collected mid-flight.
+_background_tasks: set[asyncio.Task] = set()
+
+
+def spawn(coro, name: str | None = None) -> asyncio.Task:
+    """create_task with a strong reference held until completion."""
+    t = asyncio.create_task(coro, name=name)
+    _background_tasks.add(t)
+    t.add_done_callback(_background_tasks.discard)
+    return t
+
 
 class WorkerState(enum.Enum):
     BUSY = "busy"  # did work, call work() again immediately

@@ -1,0 +1,148 @@
+"""Layout engine: property tests over random clusters (reference
+src/rpc/layout/test.rs pattern), history CRDT convergence, trackers."""
+
+import random
+
+import pytest
+
+from garage_tpu.rpc.layout.history import LayoutHistory
+from garage_tpu.rpc.layout.types import N_PARTITIONS, NodeRole
+from garage_tpu.rpc.layout.version import LayoutError, LayoutVersion
+from garage_tpu.rpc.replication_mode import ReplicationMode
+
+
+def nid(i):
+    return bytes([i]) * 32
+
+
+def test_quorum_arithmetic():
+    m = ReplicationMode(3, "consistent")
+    assert (m.read_quorum(), m.write_quorum()) == (2, 2)
+    assert ReplicationMode(2, "consistent").read_quorum() == 1
+    assert ReplicationMode(2, "consistent").write_quorum() == 2
+    assert ReplicationMode(3, "degraded").read_quorum() == 1
+    assert ReplicationMode(3, "dangerous").write_quorum() == 1
+    assert ReplicationMode(1, "consistent").read_quorum() == 1
+    assert m.is_read_after_write_consistent()
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_cluster_properties(seed):
+    """Random topology: invariants hold, the partition size is maximal
+    (primary optimality criterion), and per-node load tracks capacity."""
+    rng = random.Random(seed)
+    rf = rng.choice([1, 2, 3])
+    n_nodes = rng.randint(rf, 8)
+    n_zones = rng.randint(1, min(4, n_nodes))
+    roles = {}
+    for i in range(n_nodes):
+        roles[nid(i)] = NodeRole(
+            zone=f"z{rng.randrange(n_zones)}",
+            capacity=rng.randint(50, 500) * 10**9,
+        )
+    lv = LayoutVersion(1, rf, "maximum", roles)
+    lv.compute_assignment(None)
+    lv.check()
+
+    # partition size maximality: size+1 must be infeasible
+    storage = lv.storage_nodes()
+    zones = sorted({roles[n].zone for n in storage})
+    caps = [roles[n].capacity for n in storage]
+    z = lv.effective_zone_redundancy()
+    assert lv._feasible(storage, zones, caps, z, lv.partition_size)
+    assert not lv._feasible(storage, zones, caps, z, lv.partition_size + 1)
+
+
+def test_minimal_moves_on_node_add():
+    roles = {nid(i): NodeRole(zone=f"dc{i % 3}", capacity=200 * 10**9) for i in range(6)}
+    lv1 = LayoutVersion(1, 3, "maximum", roles)
+    lv1.compute_assignment(None)
+    roles2 = dict(roles)
+    roles2[nid(9)] = NodeRole(zone="dc0", capacity=200 * 10**9)
+    lv2 = LayoutVersion(2, 3, "maximum", roles2)
+    lv2.compute_assignment(lv1)
+    lv2.check()
+    new_idx = lv2.storage_nodes().index(nid(9))
+    gained = lv2._n_partitions_of(new_idx)
+    # the new node takes a fair share, and total moves track what it gained
+    assert gained > 0
+    moved = 0
+    for p in range(N_PARTITIONS):
+        prev_nodes = set(lv1.nodes_of_partition(p))
+        cur_nodes = set(lv2.nodes_of_partition(p))
+        moved += len(cur_nodes - prev_nodes)
+    assert moved <= gained + 16, f"moves {moved} far above new-node share {gained}"
+
+
+def test_errors():
+    with pytest.raises(LayoutError):
+        LayoutVersion(1, 3, "maximum", {nid(0): NodeRole("z", 10**9)}).compute_assignment(None)
+    with pytest.raises(LayoutError):
+        # zone_redundancy 2 but only one zone
+        lv = LayoutVersion(
+            1, 2, 2, {nid(0): NodeRole("z", 10**9), nid(1): NodeRole("z", 10**9)}
+        )
+        lv.compute_assignment(None)
+
+
+def test_gateway_nodes_store_nothing():
+    roles = {nid(i): NodeRole(zone="z", capacity=10**11) for i in range(3)}
+    roles[nid(9)] = NodeRole(zone="z", capacity=None)  # gateway
+    lv = LayoutVersion(1, 3, "maximum", roles)
+    lv.compute_assignment(None)
+    lv.check()
+    assert nid(9) in lv.node_id_vec
+    gw_idx = lv.node_id_vec.index(nid(9))
+    assert all(gw_idx not in a for a in lv.ring_assignment)
+
+
+def _mk_history(rf=3, n=3):
+    h = LayoutHistory.initial(rf)
+    for i in range(n):
+        h.staging.stage_role(nid(i), NodeRole(zone=f"z{i}", capacity=10**11))
+    h.apply_staged_changes()
+    return h
+
+
+def test_history_staging_apply_and_converge():
+    h1 = _mk_history()
+    assert h1.current().version == 1
+    assert len(h1.write_sets_of(b"\x42" * 32)) == 1
+
+    # divergent staging on two replicas converges after mutual merge
+    import copy
+
+    h2 = copy.deepcopy(h1)
+    h1.staging.stage_role(nid(7), NodeRole(zone="z0", capacity=10**11))
+    h2.staging.stage_role(nid(8), NodeRole(zone="z1", capacity=10**11))
+    h1.merge(h2)
+    h2.merge(h1)
+    assert h1.staging_digest() == h2.staging_digest()
+    assert h1.digest() == h2.digest()
+
+
+def test_history_migration_trackers():
+    h = _mk_history()
+    v1 = h.current().version
+    # add a node and apply: two active versions during migration
+    h.staging.stage_role(nid(7), NodeRole(zone="z0", capacity=10**11))
+    h.apply_staged_changes()
+    assert [v.version for v in h.versions] == [v1, v1 + 1]
+    hh = b"\x42" * 32
+    assert len(h.write_sets_of(hh)) == 2  # writes span both versions
+    assert h.read_version().version == v1  # reads stay on the synced version
+
+    # all nodes sync the new version, then ack the sync
+    for i in [0, 1, 2, 7]:
+        h.mark_synced(nid(i), v1 + 1)
+    assert h.read_version().version == v1 + 1  # reads switch
+    for i in [0, 1, 2, 7]:
+        h.update_trackers_of(nid(i))
+    assert [v.version for v in h.versions] == [v1 + 1]  # old version retired
+    assert len(h.write_sets_of(hh)) == 1
+
+
+def test_history_serde_roundtrip():
+    h = _mk_history()
+    h2 = LayoutHistory.from_obj(h.to_obj())
+    assert h2.digest() == h.digest()

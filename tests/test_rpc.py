@@ -1,0 +1,184 @@
+"""System gossip + quorum RPC helper tests (in-process multi-node)."""
+
+import asyncio
+
+import pytest
+
+from garage_tpu.net import NetApp
+from garage_tpu.net.handshake import gen_node_key
+from garage_tpu.net.message import Resp
+from garage_tpu.rpc.layout.manager import LayoutManager
+from garage_tpu.rpc.layout.types import NodeRole
+from garage_tpu.rpc.replication_mode import ReplicationMode
+from garage_tpu.rpc.rpc_helper import RpcHelper
+from garage_tpu.rpc.system import System
+from garage_tpu.utils.error import Quorum
+
+NETKEY = b"k" * 32
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_cluster(n=3, rf=3):
+    """n fully-meshed System instances on localhost."""
+    apps = []
+    for _ in range(n):
+        app = NetApp(NETKEY, gen_node_key())
+        await app.listen("127.0.0.1", 0)
+        apps.append(app)
+    systems = []
+    for i, app in enumerate(apps):
+        peers = [(a.id, a.bind_addr) for a in apps if a is not app]
+        lm = LayoutManager(app.id, rf)
+        sysd = System(app, lm, ReplicationMode(rf), bootstrap=peers)
+        await sysd.start()
+        systems.append(sysd)
+    # wait for the full mesh
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if all(len(s.peering.connected_peers()) == n - 1 for s in systems):
+            break
+    assert all(len(s.peering.connected_peers()) == n - 1 for s in systems)
+    return apps, systems
+
+
+async def stop_cluster(apps, systems):
+    for s in systems:
+        await s.stop()
+    for a in apps:
+        await a.shutdown()
+
+
+def test_layout_gossip_converges():
+    async def main():
+        apps, systems = await make_cluster(3)
+        try:
+            # operator stages roles on node 0 and applies
+            lm0 = systems[0].layout_manager
+            for app in apps:
+                lm0.stage_role(app.id, NodeRole(zone="dc1", capacity=10**11))
+            lm0.apply_staged()
+            # gossip propagates the new layout to everyone
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if all(
+                    s.layout_manager.digest() == lm0.digest() for s in systems
+                ):
+                    break
+            assert all(
+                s.layout_manager.digest() == lm0.digest() for s in systems
+            ), "layout digests did not converge"
+            assert systems[2].layout_manager.history.current().version == 1
+            # health: all nodes up, quorum everywhere
+            h = systems[0].health()
+            assert h.status in ("healthy", "degraded")  # degraded until acks spread
+            assert h.storage_nodes == 3
+        finally:
+            await stop_cluster(apps, systems)
+
+    run(main())
+
+
+def test_try_call_many_quorum():
+    async def main():
+        apps, systems = await make_cluster(3)
+        try:
+            calls = []
+
+            def mk_handler(i):
+                async def h(from_id, req):
+                    calls.append(i)
+                    if i == 1:
+                        raise ValueError("node 1 always fails")
+                    return Resp(f"ok{i}")
+
+                return h
+
+            for i, app in enumerate(apps):
+                app.endpoint("t/q").set_handler(mk_handler(i))
+            helper = RpcHelper(apps[0].id, systems[0].peering)
+            ep = apps[0].endpoint("t/q")
+            nodes = [a.id for a in apps]
+            # quorum 2 of 3 succeeds despite node 1 failing
+            res = await helper.try_call_many(ep, nodes, "x", quorum=2)
+            assert sorted(res_bodies(res)) == ["ok0", "ok2"]
+            # quorum 3 of 3 cannot be reached
+            with pytest.raises(Quorum):
+                await helper.try_call_many(ep, nodes, "x", quorum=3)
+        finally:
+            await stop_cluster(apps, systems)
+
+    def res_bodies(res):
+        return [r.body for r in res]
+
+    run(main())
+
+
+def test_staggered_read_prefers_self():
+    async def main():
+        apps, systems = await make_cluster(3)
+        try:
+            handled_by = []
+
+            def mk(i):
+                async def h(from_id, req):
+                    handled_by.append(i)
+                    return Resp(i)
+
+                return h
+
+            for i, app in enumerate(apps):
+                app.endpoint("t/r").set_handler(mk(i))
+            helper = RpcHelper(apps[0].id, systems[0].peering)
+            ep = apps[0].endpoint("t/r")
+            res = await helper.try_call_many(
+                ep, [a.id for a in apps], "x", quorum=1, all_at_once=False
+            )
+            assert res[0].body == 0, "self should serve the read"
+            assert handled_by == [0], f"extra requests launched: {handled_by}"
+        finally:
+            await stop_cluster(apps, systems)
+
+    run(main())
+
+
+def test_try_write_many_sets():
+    async def main():
+        apps, systems = await make_cluster(3)
+        try:
+            received = {i: 0 for i in range(3)}
+
+            def mk(i, fail=False):
+                async def h(from_id, req):
+                    if fail:
+                        raise ValueError("down")
+                    received[i] += 1
+                    return Resp(None)
+
+                return h
+
+            for i, app in enumerate(apps):
+                app.endpoint("t/w").set_handler(mk(i))
+            helper = RpcHelper(apps[0].id, systems[0].peering)
+            ep = apps[0].endpoint("t/w")
+            ids = [a.id for a in apps]
+            # two overlapping sets (layout transition): quorum 2 in each
+            await helper.try_write_many_sets(
+                ep, [[ids[0], ids[1], ids[2]], [ids[1], ids[2]]], "x", quorum=2
+            )
+            await asyncio.sleep(0.2)  # leftover background writes land
+            assert all(v == 1 for v in received.values())
+
+            # now node 1 and node 2 both fail: second set cannot reach quorum
+            apps[1].endpoint("t/w").set_handler(mk(1, fail=True))
+            apps[2].endpoint("t/w").set_handler(mk(2, fail=True))
+            with pytest.raises(Quorum):
+                await helper.try_write_many_sets(
+                    ep, [[ids[0], ids[1]], [ids[1], ids[2]]], "x", quorum=2
+                )
+        finally:
+            await stop_cluster(apps, systems)
+
+    run(main())
