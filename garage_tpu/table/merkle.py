@@ -1,0 +1,179 @@
+"""Incremental per-partition Merkle trie (reference src/table/merkle.rs).
+
+A sparse 256-ary patricia-style trie over entry tree-keys, one root per
+sync partition.  Nodes (stored in the `<name>:merkle_tree` db tree, keyed
+`[partition u8] || prefix bytes`):
+
+  None                       empty
+  ["L", key, value_hash]     leaf: entry `key` with blake2(serialized value)
+  ["I", [[byte, child_hash], ...], term]
+      intermediate: children at prefix+byte, plus an optional `term` =
+      [key, value_hash] for the single key that ENDS exactly at this
+      prefix (sort keys have variable length, so one tree key may be a
+      strict prefix of another)
+
+Canonical shape invariant (content-addressed: equal key sets => equal
+trees): a prefix holding 0 keys stores nothing, 1 key stores a leaf,
+>= 2 keys stores an intermediate.
+
+node_hash = blake2(msgpack(node)); parent references child by hash so any
+difference propagates to the root — two replicas with equal roots hold
+bit-identical partitions.  The MerkleWorker consumes `merkle_todo`
+(key -> new value hash, b"" = deleted) and updates leaf + path in one
+transaction per item.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ..db import Tx
+from ..utils.background import Worker, WorkerState
+from ..utils.data import blake2sum
+from ..utils.serde import pack, unpack
+from .data import TableData
+
+logger = logging.getLogger("garage.table.merkle")
+
+EMPTY_HASH = b"\x00" * 32
+
+
+def node_hash(node: Any) -> bytes:
+    if node is None:
+        return EMPTY_HASH
+    return blake2sum(pack(node))
+
+
+class MerkleUpdater:
+    def __init__(self, data: TableData):
+        self.data = data
+
+    # --- node storage ---------------------------------------------------------
+
+    def _nk(self, partition: int, prefix: bytes) -> bytes:
+        return bytes([partition]) + prefix
+
+    def get_node(self, partition: int, prefix: bytes, tx: Tx | None = None) -> Any:
+        raw = (
+            tx.get(self.data.merkle_tree, self._nk(partition, prefix))
+            if tx
+            else self.data.merkle_tree.get(self._nk(partition, prefix))
+        )
+        return None if raw is None else unpack(raw)
+
+    def _put_node(self, tx: Tx, partition: int, prefix: bytes, node: Any) -> bytes:
+        k = self._nk(partition, prefix)
+        if node is None:
+            tx.remove(self.data.merkle_tree, k)
+            return EMPTY_HASH
+        tx.insert(self.data.merkle_tree, k, pack(node))
+        return node_hash(node)
+
+    def root_hash(self, partition: int) -> bytes:
+        return node_hash(self.get_node(partition, b""))
+
+    # --- incremental update ----------------------------------------------------
+
+    def update_item(self, key: bytes, value_hash: bytes) -> None:
+        """Apply one merkle_todo item (value_hash = b'' means deleted)."""
+        partition = self.data.replication.partition_of(key[:32])
+
+        def txf(tx: Tx):
+            # recheck todo under tx (a newer update may have superseded it)
+            self._update_rec(tx, partition, b"", key, value_hash or None)
+            return None
+
+        self.data.db.transaction(txf)
+
+    def _update_rec(
+        self, tx: Tx, partition: int, prefix: bytes, key: bytes, vhash: bytes | None
+    ) -> bytes:
+        """Insert/update/delete `key` under node at `prefix`; returns the
+        node's new hash."""
+        node = self.get_node(partition, prefix, tx)
+        depth = len(prefix)
+        if node is None:
+            if vhash is None:
+                return EMPTY_HASH
+            return self._put_node(tx, partition, prefix, ["L", key, vhash])
+        if node[0] == "L":
+            lkey, lhash = bytes(node[1]), bytes(node[2])
+            if lkey == key:
+                if vhash is None:
+                    return self._put_node(tx, partition, prefix, None)
+                return self._put_node(tx, partition, prefix, ["L", key, vhash])
+            if vhash is None:
+                return node_hash(node)  # deleting an absent key: no-op
+            # split: push the existing leaf down (or into the term slot if
+            # it ends here), then insert the new key
+            if len(lkey) == depth:
+                inter = ["I", [], [lkey, lhash]]
+            else:
+                cb = lkey[depth]
+                ch = self._put_node(
+                    tx, partition, prefix + bytes([cb]), ["L", lkey, lhash]
+                )
+                inter = ["I", [[cb, ch]], None]
+            self._put_node(tx, partition, prefix, inter)
+            return self._update_rec(tx, partition, prefix, key, vhash)
+        # intermediate
+        children = {int(c): bytes(h) for c, h in node[1]}
+        term = node[2]
+        if len(key) == depth:
+            term = None if vhash is None else [key, vhash]
+        else:
+            b = key[depth]
+            ch = self._update_rec(tx, partition, prefix + bytes([b]), key, vhash)
+            if ch == EMPTY_HASH:
+                children.pop(b, None)
+            else:
+                children[b] = ch
+        # restore the canonical-shape invariant (0 keys -> empty, 1 -> leaf)
+        if not children:
+            if term is None:
+                return self._put_node(tx, partition, prefix, None)
+            return self._put_node(
+                tx, partition, prefix, ["L", bytes(term[0]), bytes(term[1])]
+            )
+        if len(children) == 1 and term is None:
+            ((only_b, _h),) = children.items()
+            child = self.get_node(partition, prefix + bytes([only_b]), tx)
+            if child is not None and child[0] == "L":
+                self._put_node(tx, partition, prefix + bytes([only_b]), None)
+                return self._put_node(
+                    tx, partition, prefix, ["L", bytes(child[1]), bytes(child[2])]
+                )
+        return self._put_node(
+            tx,
+            partition,
+            prefix,
+            ["I", [[c, children[c]] for c in sorted(children)], term],
+        )
+
+
+class MerkleWorker(Worker):
+    """Drains merkle_todo into the trie (reference merkle.rs:79-)."""
+
+    def __init__(self, updater: MerkleUpdater):
+        self.updater = updater
+        self.data = updater.data
+
+    def name(self) -> str:
+        return f"merkle:{self.data.schema.table_name}"
+
+    def status(self):
+        return {"todo": len(self.data.merkle_todo)}
+
+    async def work(self) -> WorkerState:
+        n = 0
+        for key, vhash in self.data.merkle_todo.iter_range():
+            self.updater.update_item(key, vhash)
+            # only clear the todo if it wasn't superseded meanwhile
+            cur = self.data.merkle_todo.get(key)
+            if cur == vhash:
+                self.data.merkle_todo.remove(key)
+            n += 1
+            if n >= 100:
+                break
+        return WorkerState.BUSY if n else WorkerState.IDLE

@@ -1,0 +1,124 @@
+"""Replication strategies (reference src/table/replication/).
+
+TableShardedReplication — data tables: each entry lives on the rf nodes
+the layout assigns to hash(pk); quorums from the replication mode
+(sharded.rs:16-50).
+
+TableFullReplication — control-plane tables (buckets, keys): every node
+stores everything; reads are local; writes go to all nodes with a majority
+quorum (fullcopy.rs:21-55).
+"""
+
+from __future__ import annotations
+
+from ..rpc.layout.types import N_PARTITIONS
+from ..rpc.system import System
+
+
+class TableReplication:
+    # full-copy tables sync as one partition covering the whole keyspace
+    full_copy = False
+
+    def read_nodes(self, hash32: bytes) -> list[bytes]:
+        raise NotImplementedError
+
+    def read_quorum(self) -> int:
+        raise NotImplementedError
+
+    def write_sets(self, hash32: bytes) -> list[list[bytes]]:
+        raise NotImplementedError
+
+    def write_quorum(self) -> int:
+        raise NotImplementedError
+
+    def storage_nodes(self, hash32: bytes) -> list[bytes]:
+        """All nodes that should (eventually) store this hash."""
+        raise NotImplementedError
+
+    def local_partitions(self, node: bytes) -> list[tuple[int, bytes]]:
+        """(partition index, first hash of partition) stored by `node`."""
+        raise NotImplementedError
+
+    def partition_of(self, hash32: bytes) -> int:
+        """Merkle/sync partition for a placement hash."""
+        raise NotImplementedError
+
+
+def partition_first_hash(p: int) -> bytes:
+    return bytes([p]) + b"\x00" * 31
+
+
+class TableShardedReplication(TableReplication):
+    def __init__(self, system: System):
+        self.system = system
+
+    @property
+    def _layout(self):
+        return self.system.layout_manager.history
+
+    def read_nodes(self, hash32: bytes) -> list[bytes]:
+        return self._layout.read_nodes_of(hash32)
+
+    def read_quorum(self) -> int:
+        return self.system.replication_mode.read_quorum()
+
+    def write_sets(self, hash32: bytes) -> list[list[bytes]]:
+        return self._layout.write_sets_of(hash32)
+
+    def write_quorum(self) -> int:
+        return self.system.replication_mode.write_quorum()
+
+    def storage_nodes(self, hash32: bytes) -> list[bytes]:
+        nodes: list[bytes] = []
+        for s in self._layout.write_sets_of(hash32):
+            for n in s:
+                if n not in nodes:
+                    nodes.append(n)
+        return nodes
+
+    def partition_of(self, hash32: bytes) -> int:
+        return hash32[0]
+
+    def local_partitions(self, node: bytes) -> list[tuple[int, bytes]]:
+        out = []
+        for p in range(N_PARTITIONS):
+            fh = partition_first_hash(p)
+            if any(node in v.nodes_of_partition(p) for v in self._layout.versions if v.ring_assignment):
+                out.append((p, fh))
+        return out
+
+
+class TableFullReplication(TableReplication):
+    full_copy = True
+
+    def __init__(self, system: System):
+        self.system = system
+
+    def _all_nodes(self) -> list[bytes]:
+        nodes = self.system.layout_manager.history.all_nodes()
+        if not nodes:
+            nodes = [self.system.id]
+        return nodes
+
+    def read_nodes(self, hash32: bytes) -> list[bytes]:
+        return [self.system.id]  # always readable locally
+
+    def read_quorum(self) -> int:
+        return 1
+
+    def write_sets(self, hash32: bytes) -> list[list[bytes]]:
+        return [self._all_nodes()]
+
+    def write_quorum(self) -> int:
+        n = len(self._all_nodes())
+        return n // 2 + 1
+
+    def storage_nodes(self, hash32: bytes) -> list[bytes]:
+        return self._all_nodes()
+
+    def partition_of(self, hash32: bytes) -> int:
+        return 0
+
+    def local_partitions(self, node: bytes) -> list[tuple[int, bytes]]:
+        # full-copy tables sync as a single partition 0
+        return [(0, partition_first_hash(0))]

@@ -1,0 +1,191 @@
+"""Table: quorum reads/writes over TableData + RPC endpoint.
+
+Reference src/table/table.rs:36-139.  RPC ops (one endpoint per table):
+  ["U",  [values...]]                       replicate serialized entries
+  ["RE", pk, sk]                            read one entry
+  ["RR", pk, start_sk, filt, limit, rev]    read a range
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ..db import Db
+from ..net.message import PRIO_NORMAL, Req, Resp
+from ..rpc.rpc_helper import RpcHelper
+from ..rpc.system import System
+from ..utils.background import BackgroundRunner, spawn
+from ..utils.serde import pack
+from .data import TableData
+from .gc import TableGc
+from .merkle import MerkleUpdater, MerkleWorker
+from .queue import InsertQueueWorker
+from .replication import TableReplication
+from .schema import TableSchema
+from .sync import TableSyncer
+
+logger = logging.getLogger("garage.table")
+
+
+class Table:
+    def __init__(
+        self,
+        system: System,
+        helper: RpcHelper,
+        db: Db,
+        schema: TableSchema,
+        replication: TableReplication,
+    ):
+        self.system = system
+        self.helper = helper
+        self.schema = schema
+        self.replication = replication
+        self.data = TableData(db, schema, replication)
+        self.merkle = MerkleUpdater(self.data)
+        self.endpoint = system.netapp.endpoint(f"table/{schema.table_name}")
+        self.endpoint.set_handler(self._handle)
+        self.syncer = TableSyncer(self)
+        self.gc = TableGc(self)
+
+    def spawn_workers(self, bg: BackgroundRunner) -> None:
+        bg.spawn(MerkleWorker(self.merkle))
+        bg.spawn(self.syncer.worker())
+        bg.spawn(self.gc.worker())
+        bg.spawn(InsertQueueWorker(self))
+
+    # --- writes ---------------------------------------------------------------
+
+    async def insert(self, entry) -> None:
+        await self.insert_many([entry])
+
+    async def insert_many(self, entries: list) -> None:
+        """Quorum write: group by placement hash, write each group to every
+        active layout version's node set (reference table.rs:106-139)."""
+        by_sets: dict[bytes, tuple[list[list[bytes]], list[bytes]]] = {}
+        for e in entries:
+            pk = self.schema.entry_partition_key(e)
+            h = self.schema.partition_hash(pk)
+            v = pack(self.schema.encode_entry(e))
+            write_sets = self.replication.write_sets(h)
+            # group by the exact per-version sets (not their union): quorum
+            # is accounted per set, so two hashes may only share a batch if
+            # their sets are identical
+            key = pack([sorted(s) for s in write_sets])
+            if key not in by_sets:
+                by_sets[key] = (write_sets, [])
+            by_sets[key][1].append(v)
+        for write_sets, values in by_sets.values():
+            await self.helper.try_write_many_sets(
+                self.endpoint,
+                write_sets,
+                ["U", values],
+                quorum=self.replication.write_quorum(),
+            )
+
+    def queue_insert(self, entry) -> None:
+        """Asynchronous local insert (reference table/queue.rs): cheap,
+        batched into quorum writes by the InsertQueueWorker."""
+        self.data.queue_insert(entry)
+
+    # --- reads ----------------------------------------------------------------
+
+    async def get(self, pk: bytes, sk: bytes):
+        h = self.schema.partition_hash(pk)
+        nodes = self.replication.read_nodes(h)
+        quorum = self.replication.read_quorum()
+        resps = await self.helper.try_call_many(
+            self.endpoint, nodes, ["RE", pk, sk], quorum=quorum, all_at_once=False
+        )
+        values = [r.body for r in resps]
+        ent = None
+        n_some = 0
+        for v in values:
+            if v is not None:
+                n_some += 1
+                dec = self.data.decode(v)
+                ent = dec if ent is None else self.schema.merge_entries(ent, dec)
+        if ent is not None and (n_some < len(values) or _differ(values)):
+            # read-repair: push the merged value back to stale replicas
+            spawn(self._repair([ent], nodes))
+        return ent
+
+    async def get_range(
+        self,
+        pk: bytes,
+        start_sk: bytes | None = None,
+        filt: Any = None,
+        limit: int = 1000,
+        reverse: bool = False,
+    ) -> list:
+        h = self.schema.partition_hash(pk)
+        nodes = self.replication.read_nodes(h)
+        quorum = self.replication.read_quorum()
+        resps = await self.helper.try_call_many(
+            self.endpoint,
+            nodes,
+            ["RR", pk, start_sk, filt, limit, reverse],
+            quorum=quorum,
+            all_at_once=False,
+        )
+        merged: dict[bytes, Any] = {}
+        seen_values: dict[bytes, set[bytes]] = {}
+        for r in resps:
+            for v in r.body:
+                ent = self.data.decode(v)
+                sk = self.schema.entry_sort_key(ent)
+                if sk in merged:
+                    merged[sk] = self.schema.merge_entries(merged[sk], ent)
+                else:
+                    merged[sk] = ent
+                seen_values.setdefault(sk, set()).add(bytes(v))
+        if len(resps) > 1:
+            to_repair = [
+                merged[sk]
+                for sk, vals in seen_values.items()
+                if len(vals) > 1
+            ]
+            if to_repair:
+                spawn(self._repair(to_repair, nodes))
+        out = sorted(merged.items(), key=lambda kv: kv[0], reverse=reverse)
+        ents = [e for _sk, e in out if self.schema.matches_filter(e, filt)]
+        return ents[:limit]
+
+    async def _repair(self, entries: list, nodes: list[bytes]) -> None:
+        try:
+            values = [pack(self.schema.encode_entry(e)) for e in entries]
+            await self.helper.try_call_many(
+                self.endpoint,
+                nodes,
+                ["U", values],
+                quorum=len(nodes),
+                prio=PRIO_NORMAL,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.debug("read-repair failed: %r", e)
+
+    # --- rpc handler ----------------------------------------------------------
+
+    async def _handle(self, from_id: bytes, req: Req) -> Resp:
+        op = req.body
+        if op[0] == "U":
+            for v in op[1]:
+                self.data.update_entry(bytes(v))
+            return Resp(None)
+        if op[0] == "RE":
+            return Resp(self.data.read_entry(bytes(op[1]), bytes(op[2])))
+        if op[0] == "RR":
+            vals = self.data.read_range(
+                bytes(op[1]),
+                bytes(op[2]) if op[2] is not None else None,
+                op[3],
+                int(op[4]),
+                bool(op[5]),
+            )
+            return Resp(vals)
+        raise ValueError(f"unknown table op {op[0]!r}")
+
+
+def _differ(values: list) -> bool:
+    norm = {bytes(v) for v in values if v is not None}
+    return len(norm) > 1
