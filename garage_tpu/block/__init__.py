@@ -1,0 +1,19 @@
+"""Content-addressed block store (reference src/block/).
+
+Objects are chunked into blocks (1 MiB default) identified by the BLAKE2
+hash of their plaintext.  Blocks live as files under the data directories,
+optionally zstd-compressed, replicated (or erasure-coded — the rebuild's
+TPU north star) to the nodes the layout assigns to the block hash.
+
+  codec/    BlockCodec seam: ReplicaCodec (whole copies) and EcCodec
+            (GF(2^8) Reed-Solomon shards, batched on TPU)
+  layout    multi-drive data layout (1024 sub-partitions ∝ capacity)
+  rc        transactional per-block reference counts
+  manager   the BlockManager: local files + Get/Put/Need RPCs + quorum
+  resync    persistent retry queue: fetch missing / offload unneeded
+  repair    scrub (verify all blocks), full repair, drive rebalance
+"""
+
+from .manager import BlockManager
+
+__all__ = ["BlockManager"]

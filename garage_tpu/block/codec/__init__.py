@@ -1,0 +1,13 @@
+from .base import BlockCodec
+from .replica import ReplicaCodec
+
+__all__ = ["BlockCodec", "ReplicaCodec", "get_codec"]
+
+
+def get_codec(ec_params=None, tpu_enable=True, platform=None) -> BlockCodec:
+    if ec_params is None:
+        return ReplicaCodec()
+    from .ec import EcCodec
+
+    k, m = ec_params
+    return EcCodec(k, m, tpu_enable=tpu_enable, platform=platform)

@@ -1,0 +1,139 @@
+"""Erasure codec: GF(2^8) Cauchy Reed-Solomon, batched on TPU.
+
+A block becomes k data shards + m parity shards; any k of the k+m pieces
+reconstruct it.  Shard size is padded to a multiple of 64 bytes so the
+fused scrub pipeline can BLAKE3-hash shards on-device
+(garage_tpu/models/pipeline.py).
+
+Single blocks go through the numpy LUT reference codec (dispatch latency
+dominates for one block); batches go to the XLA bit-plane kernel
+(ops/ec_tpu.py) when enabled, which groups reconstructions by erasure
+pattern so thousands of blocks repair in a handful of device dispatches.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ...ops import gf
+from .base import BlockCodec
+
+logger = logging.getLogger("garage.block.codec")
+
+SHARD_ALIGN = 64  # blake3 batch hashing wants multiples of 64 bytes
+TPU_BATCH_MIN = 8  # below this, the numpy path wins
+
+
+class EcCodec(BlockCodec):
+    def __init__(self, k: int, m: int, tpu_enable: bool = True, platform=None):
+        self.k, self.m = k, m
+        self.n_pieces = k + m
+        self.min_pieces = k
+        self._tpu = None
+        if tpu_enable:
+            try:
+                from ...ops.ec_tpu import EcTpu
+
+                self._tpu = EcTpu(k, m, platform=platform)
+            except Exception as e:  # noqa: BLE001 — fall back to numpy
+                logger.warning("TPU codec unavailable, using numpy: %r", e)
+
+    def piece_len(self, block_len: int) -> int:
+        s = (block_len + self.k - 1) // self.k
+        return (s + SHARD_ALIGN - 1) // SHARD_ALIGN * SHARD_ALIGN
+
+    def _split(self, block: bytes) -> np.ndarray:
+        s = self.piece_len(len(block))
+        buf = np.zeros(self.k * s, dtype=np.uint8)
+        buf[: len(block)] = np.frombuffer(block, dtype=np.uint8)
+        return buf.reshape(self.k, s)
+
+    # --- scalar API ----------------------------------------------------------
+
+    def encode(self, block: bytes) -> list[bytes]:
+        data = self._split(block)[None]  # (1, k, s)
+        parity = gf.encode_blocks_ref(data, self.k, self.m)[0]
+        return [bytes(data[0, i]) for i in range(self.k)] + [
+            bytes(parity[i]) for i in range(self.m)
+        ]
+
+    def decode(self, pieces: dict[int, bytes], block_len: int) -> bytes:
+        data_idx = [i for i in range(self.k) if i in pieces]
+        if len(data_idx) == self.k:
+            return b"".join(pieces[i] for i in range(self.k))[:block_len]
+        missing = [i for i in range(self.k) if i not in pieces]
+        rec = self.reconstruct_pieces(pieces, missing, block_len)
+        full = {**pieces, **rec}
+        return b"".join(full[i] for i in range(self.k))[:block_len]
+
+    def reconstruct_pieces(
+        self, pieces: dict[int, bytes], want: list[int], block_len: int
+    ) -> dict[int, bytes]:
+        present = sorted(pieces.keys())
+        if len(present) < self.k:
+            raise ValueError(
+                f"need {self.k} pieces to reconstruct, have {len(present)}"
+            )
+        use = present[: self.k]
+        s = self.piece_len(block_len)
+        shards = np.stack([np.frombuffer(pieces[i], dtype=np.uint8) for i in use])[
+            None
+        ]  # (1, k, s)
+        assert shards.shape[-1] == s, (shards.shape, s)
+        rec = gf.reconstruct_blocks_ref(shards, self.k, self.m, use, want)[0]
+        return {w: bytes(rec[j]) for j, w in enumerate(want)}
+
+    # --- batched API (TPU) ----------------------------------------------------
+
+    def encode_batch(self, blocks: list[bytes]) -> list[list[bytes]]:
+        if self._tpu is None or len(blocks) < TPU_BATCH_MIN:
+            return [self.encode(b) for b in blocks]
+        # group by shard size so each group is one rectangular dispatch
+        out: list[list[bytes] | None] = [None] * len(blocks)
+        groups: dict[int, list[int]] = {}
+        for idx, b in enumerate(blocks):
+            groups.setdefault(self.piece_len(len(b)), []).append(idx)
+        for s, idxs in groups.items():
+            data = np.stack([self._split(blocks[i]) for i in idxs])  # (B,k,s)
+            parity = self._tpu.encode(data)  # (B,m,s)
+            for j, i in enumerate(idxs):
+                out[i] = [bytes(data[j, x]) for x in range(self.k)] + [
+                    bytes(parity[j, x]) for x in range(self.m)
+                ]
+        return out  # type: ignore[return-value]
+
+    def reconstruct_batch(self, batches):
+        for idx, (pieces, _w, _n) in enumerate(batches):
+            if len(pieces) < self.k:
+                raise ValueError(
+                    f"batch entry {idx}: need {self.k} pieces to "
+                    f"reconstruct, have {len(pieces)}"
+                )
+        if self._tpu is None or len(batches) < TPU_BATCH_MIN:
+            return [self.reconstruct_pieces(p, w, n) for p, w, n in batches]
+        out: list[dict[int, bytes] | None] = [None] * len(batches)
+        # group by (erasure pattern, want, shard size): one kernel call per
+        # group, one compiled kernel per shard shape overall
+        groups: dict[tuple, list[int]] = {}
+        for idx, (pieces, want, block_len) in enumerate(batches):
+            present = tuple(sorted(pieces.keys())[: self.k])
+            key = (present, tuple(sorted(want)), self.piece_len(block_len))
+            groups.setdefault(key, []).append(idx)
+        for (present, want, s), idxs in groups.items():
+            shards = np.stack(
+                [
+                    np.stack(
+                        [
+                            np.frombuffer(batches[i][0][p], dtype=np.uint8)
+                            for p in present
+                        ]
+                    )
+                    for i in idxs
+                ]
+            )  # (B, k, s)
+            rec = self._tpu.reconstruct(shards, list(present), list(want))
+            for j, i in enumerate(idxs):
+                out[i] = {w: bytes(rec[j, x]) for x, w in enumerate(want)}
+        return out  # type: ignore[return-value]

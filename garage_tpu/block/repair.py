@@ -1,0 +1,164 @@
+"""Scrub / repair / rebalance workers (reference src/block/repair.rs).
+
+RepairWorker  — walk the whole rc table and queue every block for resync;
+                one-shot, spawned by the CLI `repair blocks` command (M5).
+ScrubWorker   — continuously read + verify every block file on disk
+                (tranquilized pacing; corrupted files are quarantined and
+                queued for re-fetch).  Progress (cursor) is persisted so
+                restarts resume.  The EC scrub fast path batches shard
+                hashing through the TPU pipeline (M8).
+RebalanceWorker — move block files to their new primary directory after a
+                multi-drive layout change; one-shot, spawned by the CLI
+                `repair rebalance` command (M5).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ..utils.background import Worker, WorkerState
+from ..utils.migrate import Migratable
+from ..utils.persister import Persister
+from ..utils.tranquilizer import Tranquilizer
+
+logger = logging.getLogger("garage.block.repair")
+
+SCRUB_BATCH = 16
+
+
+class RepairWorker(Worker):
+    """Queue every known block for resync (one-shot)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.cursor: bytes | None = b""
+        self.queued = 0
+
+    def name(self) -> str:
+        return "block_repair"
+
+    def status(self):
+        return {"queued": self.queued, "done": self.cursor is None}
+
+    async def work(self):
+        if self.cursor is None:
+            return WorkerState.DONE
+        n = 0
+        for key, _v in self.manager.rc.tree.iter_range(start=self.cursor):
+            self.manager.resync.queue_block(key)
+            self.cursor = key + b"\x00"
+            self.queued += 1
+            n += 1
+            if n >= 100:
+                return WorkerState.BUSY
+        self.cursor = None
+        return WorkerState.BUSY
+
+
+class ScrubPersisted(Migratable):
+    VERSION_MARKER = b"GT0scrub"
+
+    def __init__(self, cursor: bytes = b"", tranquility: int = 4, corruptions: int = 0):
+        self.cursor = cursor
+        self.tranquility = tranquility
+        self.corruptions = corruptions
+
+    def to_obj(self):
+        return [self.cursor, self.tranquility, self.corruptions]
+
+    @classmethod
+    def from_obj(cls, obj):
+        return cls(bytes(obj[0]), int(obj[1]), int(obj[2]))
+
+
+class ScrubWorker(Worker):
+    """Verify every stored block against its hash, slowly and forever."""
+
+    def __init__(self, manager, metadata_dir: str | None = None):
+        self.manager = manager
+        self.tranquilizer = Tranquilizer()
+        self.persister = (
+            Persister(metadata_dir, "scrub_info", ScrubPersisted)
+            if metadata_dir
+            else None
+        )
+        self.state = (self.persister.load() if self.persister else None) or ScrubPersisted()
+
+    def name(self) -> str:
+        return "scrub"
+
+    def status(self):
+        return {
+            "cursor": self.state.cursor.hex()[:16],
+            "corruptions": self.state.corruptions,
+        }
+
+    async def work(self):
+        self.tranquilizer.reset()
+        n = 0
+        for key, _v in self.manager.rc.tree.iter_range(start=self.state.cursor):
+            hash32 = key
+            await self._scrub_one(hash32)
+            self.state.cursor = key + b"\x00"
+            n += 1
+            if n >= SCRUB_BATCH:
+                break
+        if n == 0:
+            # cycle complete: restart from the beginning after a long rest
+            self.state.cursor = b""
+            self._save()
+            return (WorkerState.THROTTLED, 3600.0)
+        self._save()
+        delay = self.tranquilizer.tranquilize_delay(self.state.tranquility)
+        return (WorkerState.THROTTLED, max(delay, 0.05))
+
+    async def _scrub_one(self, hash32: bytes) -> None:
+        mgr = self.manager
+        found = mgr.find_block_file(hash32)
+        if found is None:
+            return
+        data = await mgr.read_block_local(hash32)  # verifies + quarantines
+        if data is None and mgr.rc.is_needed(hash32):
+            self.state.corruptions += 1
+            logger.warning("scrub: corrupted block %s queued for refetch", hash32.hex()[:16])
+
+    def _save(self):
+        if self.persister:
+            self.persister.save(self.state)
+
+
+class RebalanceWorker(Worker):
+    """Move block files onto their current primary directory (one-shot)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+        self.cursor: bytes | None = b""
+        self.moved = 0
+
+    def name(self) -> str:
+        return "rebalance"
+
+    def status(self):
+        return {"moved": self.moved, "done": self.cursor is None}
+
+    async def work(self):
+        if self.cursor is None:
+            return WorkerState.DONE
+        mgr = self.manager
+        n = 0
+        for key, _v in mgr.rc.tree.iter_range(start=self.cursor):
+            self.cursor = key + b"\x00"
+            n += 1
+            primary = mgr.data_layout.primary_dir(key)
+            want_dir = mgr.data_layout.block_dir(primary, key)
+            for piece, (path, compressed) in mgr.local_pieces(key).items():
+                want = os.path.join(want_dir, mgr._file_name(key, piece, compressed))
+                if path != want:
+                    os.makedirs(want_dir, exist_ok=True)
+                    os.replace(path, want)
+                    self.moved += 1
+            if n >= 100:
+                return WorkerState.BUSY
+        self.cursor = None
+        return WorkerState.BUSY

@@ -1,0 +1,194 @@
+"""Block resynchronization: the self-healing loop.
+
+Reference src/block/resync.rs.  A persistent, time-ordered queue of block
+hashes to (re)examine.  For each due item:
+
+  - node needs the block (rc > 0) but doesn't have it  -> fetch from peers
+  - node has it but rc == 0 past the GC delay          -> make sure no
+    storage node still needs it (Need RPC), push to any that do, then
+    delete the local file
+  - errors retry with exponential backoff 1 min -> 64 min (errors tree)
+
+Workers (1..MAX_RESYNC_WORKERS) drain the queue with a Tranquilizer.
+Resync traffic runs at PRIO_BACKGROUND: the frame scheduler guarantees it
+never starves interactive transfers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from ..net.message import PRIO_BACKGROUND
+from ..utils.background import BackgroundRunner, Worker, WorkerState
+from ..utils.time_util import now_msec
+from ..utils.tranquilizer import Tranquilizer
+
+logger = logging.getLogger("garage.block.resync")
+
+BACKOFF_MIN_MS = 60 * 1000
+BACKOFF_MAX_MS = 64 * 60 * 1000
+MAX_RESYNC_WORKERS = 8
+
+
+class BlockResyncManager:
+    def __init__(self, manager):
+        self.manager = manager
+        db = manager.db
+        self.queue = db.open_tree("block_resync_queue")  # [when|hash] -> b""
+        self.errors = db.open_tree("block_resync_errors")  # hash -> [count, when]
+        self.n_workers = 1
+        self.tranquility = 2
+        self._kick = asyncio.Event()
+
+    # --- queueing -------------------------------------------------------------
+
+    def queue_block(self, hash32: bytes, delay_ms: int = 0) -> None:
+        when = now_msec() + delay_ms
+        self.queue.insert(when.to_bytes(8, "big") + hash32, b"")
+        self._kick.set()
+
+    def queue_len(self) -> int:
+        return len(self.queue)
+
+    def errors_len(self) -> int:
+        return len(self.errors)
+
+    # --- one unit of work -----------------------------------------------------
+
+    async def resync_iter(self) -> bool:
+        """Process one due queue item; returns True if work was done."""
+        now = now_msec()
+        for key, _ in self.queue.iter_range():
+            when = int.from_bytes(key[:8], "big")
+            if when > now:
+                return False
+            hash32 = key[8:]
+            # error backoff: skip if a retry is scheduled later
+            err = self.errors.get(hash32)
+            if err is not None:
+                import msgpack
+
+                count, next_try = msgpack.unpackb(err)
+                if next_try > now:
+                    self.queue.remove(key)
+                    self.queue.insert(next_try.to_bytes(8, "big") + hash32, b"")
+                    return True
+            try:
+                await self._resync_block(hash32)
+                self.errors.remove(hash32)
+                self.queue.remove(key)
+            except Exception as e:  # noqa: BLE001
+                import msgpack
+
+                count = 0
+                if err is not None:
+                    count = msgpack.unpackb(err)[0]
+                backoff = min(BACKOFF_MAX_MS, BACKOFF_MIN_MS * (2 ** min(count, 6)))
+                self.errors.insert(
+                    hash32, msgpack.packb([count + 1, now_msec() + backoff])
+                )
+                self.queue.remove(key)
+                self.queue.insert(
+                    (now_msec() + backoff).to_bytes(8, "big") + hash32, b""
+                )
+                logger.info(
+                    "resync of %s failed (try %d): %r",
+                    hash32.hex()[:16],
+                    count + 1,
+                    e,
+                )
+            return True
+        return False
+
+    async def _resync_block(self, hash32: bytes) -> None:
+        mgr = self.manager
+        needed = mgr.rc.is_needed(hash32)
+        have = mgr.has_block(hash32)
+        i_store = mgr.system.id in mgr.storage_nodes_of(hash32)
+
+        if needed and i_store and not have:
+            data = await mgr.rpc_get_block(hash32, prio=PRIO_BACKGROUND)
+            stored, compressed = mgr._maybe_compress(data)
+            await mgr.write_block_local(hash32, stored, compressed)
+            logger.debug("resync: fetched %s", hash32.hex()[:16])
+            return
+
+        if have and (not needed or not i_store):
+            if not mgr.rc.is_deletable(hash32) and not i_store:
+                # rc still counting somewhere else; we just don't store it
+                pass
+            elif not mgr.rc.is_deletable(hash32):
+                return  # deletion delay not yet passed
+            # before deleting, push to any storage node that needs it
+            for n in mgr.storage_nodes_of(hash32):
+                if n == mgr.system.id:
+                    continue
+                try:
+                    resp = await mgr.endpoint.call(
+                        n, ["Need", hash32], prio=PRIO_BACKGROUND
+                    )
+                    if resp.body:
+                        found = mgr.find_block_file(hash32)
+                        if found:
+                            path, compressed = found
+                            with open(path, "rb") as f:
+                                stored = f.read()
+                            await mgr.endpoint.call(
+                                n,
+                                ["Put", hash32, {"c": compressed}, stored],
+                                prio=PRIO_BACKGROUND,
+                                timeout=120.0,
+                            )
+                except Exception as e:
+                    raise RuntimeError(
+                        f"cannot verify/hand off to {n.hex()[:8]}: {e!r}"
+                    ) from e
+            found = mgr.find_block_file(hash32)
+            if found:
+                try:
+                    os.remove(found[0])
+                    logger.debug("resync: deleted %s", hash32.hex()[:16])
+                except OSError:
+                    pass
+            mgr.rc.clear_deleted(hash32)
+
+    # --- workers --------------------------------------------------------------
+
+    def spawn_workers(self, bg: BackgroundRunner) -> None:
+        for i in range(MAX_RESYNC_WORKERS):
+            bg.spawn(_ResyncWorker(self, i))
+
+
+class _ResyncWorker(Worker):
+    def __init__(self, resync: BlockResyncManager, index: int):
+        self.resync = resync
+        self.index = index
+        self.tranquilizer = Tranquilizer()
+
+    def name(self) -> str:
+        return f"resync:{self.index}"
+
+    def status(self):
+        return {
+            "queue": self.resync.queue_len(),
+            "errors": self.resync.errors_len(),
+        }
+
+    async def work(self):
+        if self.index >= self.resync.n_workers:
+            return (WorkerState.THROTTLED, 10.0)  # worker disabled by config
+        self.tranquilizer.reset()
+        did = await self.resync.resync_iter()
+        if not did:
+            return WorkerState.IDLE
+        delay = self.tranquilizer.tranquilize_delay(self.resync.tranquility)
+        return (WorkerState.THROTTLED, delay) if delay else WorkerState.BUSY
+
+    async def wait_for_work(self) -> None:
+        self.resync._kick.clear()
+        try:
+            await asyncio.wait_for(self.resync._kick.wait(), timeout=10.0)
+        except asyncio.TimeoutError:
+            pass
