@@ -1,0 +1,252 @@
+"""S3 API server: routing + auth + bucket-level handlers.
+
+Reference src/api/s3/api_server.rs + router.rs.  Path-style addressing
+(`/bucket/key`) and vhost-style when a `root_domain` is configured.
+Every request is SigV4-verified against the key table, then checked
+against the key's bucket permissions.
+"""
+
+from __future__ import annotations
+
+import logging
+import urllib.parse
+
+from aiohttp import web
+
+from ...model.key_table import Key
+from ...utils.error import Error
+from ..common.error import (
+    ApiError,
+    BadRequest,
+    BucketNotEmpty,
+    Forbidden,
+    NoSuchBucket,
+    NotImplementedError_,
+)
+from ..common.error import error_xml
+from ..common.signature import check_payload, verify_request
+from .list import handle_list_objects_v1, handle_list_objects_v2
+from .objects import (
+    handle_delete_object,
+    handle_get_object,
+    handle_put_object,
+)
+from .xml_util import xml_doc
+
+logger = logging.getLogger("garage.api.s3")
+
+UNIMPLEMENTED_SUBRESOURCES = {
+    "acl", "tagging", "versioning", "policy", "logging", "notification",
+    "replication", "encryption", "requestPayment", "accelerate", "analytics",
+    "inventory", "metrics", "ownershipControls", "publicAccessBlock",
+    "intelligent-tiering", "object-lock", "legal-hold", "retention", "torrent",
+}
+
+
+class S3ApiServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.region = garage.config.s3_api.s3_region
+        self.root_domain = garage.config.s3_api.root_domain
+        self.app = web.Application(client_max_size=64 * 1024 * 1024 * 1024)
+        self.app.router.add_route("*", "/{tail:.*}", self._entry)
+        self.runner: web.AppRunner | None = None
+
+    async def start(self, host: str, port: int) -> None:
+        self.runner = web.AppRunner(self.app, access_log=None)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, host, port)
+        await site.start()
+        logger.info("s3 api listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self.runner:
+            await self.runner.cleanup()
+
+    # --- request entry --------------------------------------------------------
+
+    def _parse_target(self, request) -> tuple[str, str]:
+        """-> (bucket, key); vhost-style if host matches root_domain."""
+        path = urllib.parse.unquote(request.raw_path.split("?")[0])
+        host = request.headers.get("Host", "").split(":")[0]
+        if self.root_domain:
+            # label-boundary match: "my-s3.example.com" must NOT match a
+            # root_domain of "s3.example.com"
+            rd = self.root_domain.lstrip(".")
+            if host != rd and host.endswith("." + rd):
+                bucket = host[: -(len(rd) + 1)]
+                if bucket:
+                    return bucket, path.lstrip("/")
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key
+
+    async def _get_secret(self, key_id: str):
+        k = await self.garage.key_table.get(key_id.encode(), b"")
+        if k is None or k.is_deleted():
+            return None
+        return k.secret()
+
+    async def _entry(self, request: web.Request) -> web.StreamResponse:
+        try:
+            return await self._handle(request)
+        except ApiError as e:
+            if e.status == 304:
+                return web.Response(status=304)
+            return web.Response(
+                status=e.status,
+                text=error_xml(e, request.path),
+                content_type="application/xml",
+            )
+        except Error as e:
+            msg = str(e)
+            if "not found" in msg:
+                return web.Response(
+                    status=404,
+                    text=error_xml(NoSuchBucket(msg), request.path),
+                    content_type="application/xml",
+                )
+            logger.exception("internal error")
+            return web.Response(
+                status=500,
+                text=error_xml(ApiError(msg), request.path),
+                content_type="application/xml",
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.exception("unhandled API error")
+            return web.Response(
+                status=500,
+                text=error_xml(ApiError(repr(e)), request.path),
+                content_type="application/xml",
+            )
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        ctx = await verify_request(request, self._get_secret, self.region)
+        api_key: Key = await self.garage.helper.get_key(ctx.key_id)
+        bucket_name, key = self._parse_target(request)
+        method = request.method
+
+        for sub in UNIMPLEMENTED_SUBRESOURCES:
+            if sub in request.query:
+                raise NotImplementedError_(f"subresource {sub!r} not implemented")
+
+        if not bucket_name:
+            if method == "GET":
+                return await self._list_buckets(api_key)
+            raise BadRequest("no bucket specified")
+
+        if method == "PUT" and not key:
+            return await self._create_bucket(bucket_name, api_key, request, ctx)
+
+        bucket_id = await self.garage.helper.resolve_bucket(bucket_name, api_key)
+        perm = api_key.bucket_permissions(bucket_id)
+
+        if not key:
+            # bucket-level ops
+            if method == "HEAD":
+                _require(perm.allow_read or perm.allow_write or perm.allow_owner)
+                return web.Response(status=200)
+            if method == "GET":
+                _require(perm.allow_read)
+                if request.query.get("list-type") == "2":
+                    return await handle_list_objects_v2(
+                        self.garage, bucket_id, bucket_name, request
+                    )
+                return await handle_list_objects_v1(
+                    self.garage, bucket_id, bucket_name, request
+                )
+            if method == "DELETE":
+                _require(perm.allow_owner)
+                try:
+                    await self.garage.helper.delete_bucket(bucket_id)
+                except Error as e:
+                    if "not empty" in str(e):
+                        raise BucketNotEmpty(str(e)) from e
+                    raise
+                return web.Response(status=204)
+            raise BadRequest(f"unsupported bucket method {method}")
+
+        # object-level ops
+        if method == "PUT":
+            _require(perm.allow_write)
+            if "x-amz-copy-source" in request.headers:
+                raise NotImplementedError_("CopyObject lands in M6")
+            return await handle_put_object(
+                self.garage, bucket_id, key, request, ctx=ctx
+            )
+        if method == "GET":
+            _require(perm.allow_read)
+            return await handle_get_object(self.garage, bucket_id, key, request)
+        if method == "HEAD":
+            _require(perm.allow_read)
+            return await handle_get_object(
+                self.garage, bucket_id, key, request, head_only=True
+            )
+        if method == "DELETE":
+            _require(perm.allow_write)
+            return await handle_delete_object(self.garage, bucket_id, key)
+        raise BadRequest(f"unsupported method {method}")
+
+    # --- bucket handlers ------------------------------------------------------
+
+    async def _list_buckets(self, api_key: Key) -> web.Response:
+        params = api_key.params()
+        buckets = []
+        if params:
+            for bid, perm_obj in params.authorized_buckets.items():
+                from ...model.permission import BucketKeyPerm
+
+                if not BucketKeyPerm.from_obj(perm_obj).is_any():
+                    continue
+                try:
+                    b = await self.garage.helper.get_bucket(bytes(bid))
+                except Error:
+                    continue
+                for name, v in b.params().aliases.items():
+                    if v:
+                        buckets.append((name, b.params().creation_date))
+        from .list import _http_iso
+
+        children = [
+            ("Owner", [("ID", api_key.key_id), ("DisplayName", api_key.key_id)]),
+            (
+                "Buckets",
+                [
+                    ("Bucket", [("Name", n), ("CreationDate", _http_iso(cd))])
+                    for n, cd in sorted(buckets)
+                ],
+            ),
+        ]
+        return web.Response(
+            text=xml_doc("ListAllMyBucketsResult", children),
+            content_type="application/xml",
+        )
+
+    async def _create_bucket(self, name: str, api_key: Key, request, ctx) -> web.Response:
+        body = await request.read()
+        await check_payload(body, ctx)
+        params = api_key.params()
+        try:
+            existing = await self.garage.helper.resolve_bucket(name, api_key)
+        except Error:
+            existing = None
+        if existing is not None:
+            perm = api_key.bucket_permissions(existing)
+            if perm.allow_owner:  # idempotent re-create by the owner
+                return web.Response(status=200, headers={"Location": f"/{name}"})
+            from ..common.error import BucketAlreadyExists
+
+            raise BucketAlreadyExists(f"bucket {name!r} already exists")
+        if params is None or not params.allow_create_bucket.get():
+            raise Forbidden("this key cannot create buckets")
+        bucket_id = await self.garage.helper.create_bucket(name)
+        await self.garage.helper.set_bucket_key_permissions(
+            bucket_id, api_key.key_id, True, True, True
+        )
+        return web.Response(status=200, headers={"Location": f"/{name}"})
+
+
+def _require(cond: bool) -> None:
+    if not cond:
+        raise Forbidden("access denied for this operation")

@@ -1,0 +1,144 @@
+"""Minimal async S3 client with SigV4 signing.
+
+Replaces the aws-sdk client the reference uses in its integration tests
+(src/garage/tests/ uses aws-sdk-s3 + a custom requester; this image has
+no boto3).  Also used by the CLI and the smoke scripts.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import aiohttp
+
+from ..common.signature import sign_request_headers
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(f"{status} {code}: {message}")
+        self.status = status
+        self.code = code
+
+
+class S3Client:
+    def __init__(self, endpoint: str, key_id: str, secret: str, region: str = "garage"):
+        self.endpoint = endpoint.rstrip("/")
+        self.key_id = key_id
+        self.secret = secret
+        self.region = region
+        host = urllib.parse.urlparse(self.endpoint).netloc
+        self.host = host
+
+    async def _req(
+        self,
+        method: str,
+        path: str,
+        query: list[tuple[str, str]] | None = None,
+        body: bytes = b"",
+        headers: dict[str, str] | None = None,
+    ) -> tuple[int, dict, bytes]:
+        query = query or []
+        h = dict(headers or {})
+        h["host"] = self.host
+        signed = sign_request_headers(
+            method, path, query, h, body, self.key_id, self.secret, self.region
+        )
+        qs = urllib.parse.urlencode(query)
+        url = self.endpoint + urllib.parse.quote(path) + ("?" + qs if qs else "")
+        async with aiohttp.ClientSession() as sess:
+            async with sess.request(
+                method, url, data=body, headers=signed, skip_auto_headers=["Content-Type"]
+            ) as resp:
+                data = await resp.read()
+                return resp.status, resp.headers.copy(), data  # case-insensitive
+
+    def _check(self, status: int, data: bytes, ok=(200, 204, 206)):
+        if status not in ok:
+            code, msg = "Unknown", data.decode(errors="replace")[:200]
+            try:
+                root = ET.fromstring(data.decode())
+                code = root.findtext("Code") or code
+                msg = root.findtext("Message") or msg
+            except ET.ParseError:
+                pass
+            raise S3Error(status, code, msg)
+
+    # --- operations -----------------------------------------------------------
+
+    async def create_bucket(self, bucket: str) -> None:
+        st, _h, data = await self._req("PUT", f"/{bucket}")
+        self._check(st, data)
+
+    async def delete_bucket(self, bucket: str) -> None:
+        st, _h, data = await self._req("DELETE", f"/{bucket}")
+        self._check(st, data)
+
+    async def list_buckets(self) -> list[str]:
+        st, _h, data = await self._req("GET", "/")
+        self._check(st, data)
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        root = ET.fromstring(data.decode())
+        return [e.text for e in root.findall(".//s3:Bucket/s3:Name", ns)]
+
+    async def put_object(
+        self, bucket: str, key: str, body: bytes, content_type: str | None = None
+    ) -> str:
+        headers = {"content-type": content_type} if content_type else {}
+        st, h, data = await self._req("PUT", f"/{bucket}/{key}", body=body, headers=headers)
+        self._check(st, data)
+        return h.get("ETag", "").strip('"')
+
+    async def get_object(
+        self, bucket: str, key: str, range_: str | None = None
+    ) -> bytes:
+        headers = {"range": range_} if range_ else {}
+        st, _h, data = await self._req("GET", f"/{bucket}/{key}", headers=headers)
+        self._check(st, data)
+        return data
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        st, h, data = await self._req("HEAD", f"/{bucket}/{key}")
+        self._check(st, data)
+        return h
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        st, _h, data = await self._req("DELETE", f"/{bucket}/{key}")
+        self._check(st, data)
+
+    async def list_objects_v2(
+        self,
+        bucket: str,
+        prefix: str = "",
+        delimiter: str = "",
+        max_keys: int = 1000,
+        continuation_token: str | None = None,
+    ) -> dict:
+        q = [("list-type", "2"), ("max-keys", str(max_keys))]
+        if prefix:
+            q.append(("prefix", prefix))
+        if delimiter:
+            q.append(("delimiter", delimiter))
+        if continuation_token:
+            q.append(("continuation-token", continuation_token))
+        st, _h, data = await self._req("GET", f"/{bucket}", query=q)
+        self._check(st, data)
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        root = ET.fromstring(data.decode())
+        return {
+            "keys": [
+                {
+                    "key": c.findtext("s3:Key", namespaces=ns),
+                    "size": int(c.findtext("s3:Size", namespaces=ns) or 0),
+                    "etag": (c.findtext("s3:ETag", namespaces=ns) or "").strip('"'),
+                }
+                for c in root.findall("s3:Contents", ns)
+            ],
+            "common_prefixes": [
+                p.findtext("s3:Prefix", namespaces=ns)
+                for p in root.findall("s3:CommonPrefixes", ns)
+            ],
+            "truncated": root.findtext("s3:IsTruncated", namespaces=ns) == "true",
+            "next_token": root.findtext("s3:NextContinuationToken", namespaces=ns),
+        }

@@ -1,0 +1,168 @@
+"""Object listings: ListObjectsV2 / V1 (reference src/api/s3/list.rs —
+the pagination state machines over CRDT version lists)."""
+
+from __future__ import annotations
+
+import base64
+
+from aiohttp import web
+
+from .xml_util import xml_doc
+
+
+async def _collect(
+    garage,
+    bucket_id: bytes,
+    prefix: str,
+    delimiter: str,
+    start_after: str,
+    max_keys: int,
+):
+    """Walk the object table; fold keys under `delimiter` into common
+    prefixes.  Returns (entries, common_prefixes, truncated, next_start)
+    where next_start is the LAST PROCESSED key — the continuation resumes
+    strictly after it, so no key is dropped at page boundaries."""
+    entries = []
+    prefixes: set[str] = set()
+    # seek straight to the interesting range
+    cursor = max(start_after, prefix).encode() if prefix else start_after.encode()
+    last = cursor.decode(errors="surrogateescape")
+    while True:
+        batch = await garage.object_table.get_range(
+            bucket_id, cursor, "visible", 1000
+        )
+        if not batch:
+            break
+        for obj in batch:
+            k = obj.key
+            if cursor != b"" and k.encode() <= cursor:
+                continue
+            if prefix:
+                if not k.startswith(prefix):
+                    if k > prefix:
+                        return entries, sorted(prefixes), False, ""  # past range
+                    continue
+            if delimiter:
+                rest = k[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter)[0] + delimiter
+                    if cp not in prefixes:
+                        if len(entries) + len(prefixes) + 1 > max_keys:
+                            return entries, sorted(prefixes), True, last
+                        prefixes.add(cp)
+                    last = k
+                    continue
+            if len(entries) + len(prefixes) + 1 > max_keys:
+                return entries, sorted(prefixes), True, last
+            v = obj.last_visible()
+            meta = v.data.get("meta", {})
+            entries.append(
+                {
+                    "key": k,
+                    "size": meta.get("size", 0),
+                    "etag": meta.get("etag", ""),
+                    "ts": v.timestamp,
+                }
+            )
+            last = k
+        cursor = batch[-1].key.encode()
+        if len(batch) < 1000:
+            break
+    return entries, sorted(prefixes), False, ""
+
+
+def _http_iso(ts_ms: int) -> str:
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(ts_ms / 1000, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z"
+    )
+
+
+async def handle_list_objects_v2(garage, bucket_id: bytes, bucket_name: str, request):
+    q = request.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter", "")
+    max_keys = min(int(q.get("max-keys", "1000")), 1000)
+    token = q.get("continuation-token")
+    start_after = q.get("start-after", "")
+    if token:
+        start_after = base64.urlsafe_b64decode(token.encode()).decode()
+
+    entries, prefixes, truncated, next_start = await _collect(
+        garage, bucket_id, prefix, delimiter, start_after, max_keys
+    )
+    children = [
+        ("Name", bucket_name),
+        ("Prefix", prefix),
+        ("KeyCount", len(entries) + len(prefixes)),
+        ("MaxKeys", max_keys),
+        ("Delimiter", delimiter) if delimiter else None,
+        ("IsTruncated", truncated),
+    ]
+    if truncated:
+        children.append(
+            (
+                "NextContinuationToken",
+                base64.urlsafe_b64encode(next_start.encode()).decode(),
+            )
+        )
+    for e in entries:
+        children.append(
+            (
+                "Contents",
+                [
+                    ("Key", e["key"]),
+                    ("LastModified", _http_iso(e["ts"])),
+                    ("ETag", f'"{e["etag"]}"'),
+                    ("Size", e["size"]),
+                    ("StorageClass", "STANDARD"),
+                ],
+            )
+        )
+    for p in prefixes:
+        children.append(("CommonPrefixes", [("Prefix", p)]))
+    return web.Response(
+        text=xml_doc("ListBucketResult", children),
+        content_type="application/xml",
+    )
+
+
+async def handle_list_objects_v1(garage, bucket_id: bytes, bucket_name: str, request):
+    q = request.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter", "")
+    max_keys = min(int(q.get("max-keys", "1000")), 1000)
+    marker = q.get("marker", "")
+    entries, prefixes, truncated, next_start = await _collect(
+        garage, bucket_id, prefix, delimiter, marker, max_keys
+    )
+    children = [
+        ("Name", bucket_name),
+        ("Prefix", prefix),
+        ("Marker", marker),
+        ("MaxKeys", max_keys),
+        ("Delimiter", delimiter) if delimiter else None,
+        ("IsTruncated", truncated),
+    ]
+    if truncated and next_start:
+        children.append(("NextMarker", next_start))
+    for e in entries:
+        children.append(
+            (
+                "Contents",
+                [
+                    ("Key", e["key"]),
+                    ("LastModified", _http_iso(e["ts"])),
+                    ("ETag", f'"{e["etag"]}"'),
+                    ("Size", e["size"]),
+                    ("StorageClass", "STANDARD"),
+                ],
+            )
+        )
+    for p in prefixes:
+        children.append(("CommonPrefixes", [("Prefix", p)]))
+    return web.Response(
+        text=xml_doc("ListBucketResult", children),
+        content_type="application/xml",
+    )

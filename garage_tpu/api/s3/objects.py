@@ -1,0 +1,324 @@
+"""Object read/write handlers: the S3 hot paths.
+
+PutObject (reference src/api/s3/put.rs): chunk the body at block_size;
+objects <= INLINE_THRESHOLD live inline in the object entry; larger
+objects get an Uploading version, blocks stored with bounded parallelism
+(PUT_BLOCKS_MAX_PARALLEL in flight), block refs + version entries written
+as we go, then the version flips to Complete.  A failure marks the
+version Aborted (cleanup cascade deletes blocks).
+
+GetObject (reference src/api/s3/get.rs): resolve the newest complete
+version; inline data answers immediately; block lists stream with
+prefetch of the next block while the current one is sent; Range requests
+slice the block list.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+
+from aiohttp import web
+
+from ...block.manager import INLINE_THRESHOLD
+from ...model.s3.block_ref_table import BlockRef
+from ...model.s3.object_table import Object, ObjectVersion
+from ...model.s3.version_table import Version
+from ...utils.data import blake2sum, gen_uuid
+from ...utils.time_util import now_msec
+from ..common.error import (
+    ApiError,
+    InvalidRange,
+    NoSuchKey,
+    PreconditionFailed,
+)
+
+logger = logging.getLogger("garage.api.s3")
+
+PUT_BLOCKS_MAX_PARALLEL = 3  # reference put.rs:42
+
+SAVED_HEADERS = [
+    "content-type",
+    "content-encoding",
+    "content-language",
+    "content-disposition",
+    "cache-control",
+    "expires",
+]
+
+
+async def _read_at_least(body, n: int) -> bytes:
+    """Read until >= n bytes or EOF (StreamReader.read(n) may return any
+    currently-buffered amount — trusting one read truncates uploads)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = await body.read(n - len(buf))
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def _check_sha256(ctx, digest: "hashlib._Hash") -> None:
+    if ctx is not None and ctx.content_sha256 is not None:
+        if digest.hexdigest() != ctx.content_sha256:
+            from ..common.error import BadRequest
+
+            raise BadRequest(
+                "payload sha256 does not match x-amz-content-sha256",
+                code="XAmzContentSHA256Mismatch",
+            )
+
+
+async def handle_put_object(
+    garage, bucket_id: bytes, key: str, request, ctx=None
+) -> web.Response:
+    headers = [
+        [h, request.headers[h_orig]]
+        for h in SAVED_HEADERS
+        for h_orig in [next((k for k in request.headers if k.lower() == h), None)]
+        if h_orig
+    ]
+    body = request.content
+    block_size = garage.config.block_size
+
+    first = await _read_at_least(body, INLINE_THRESHOLD + 1)
+    if len(first) <= INLINE_THRESHOLD:
+        # inline object
+        sha = hashlib.sha256(first)
+        _check_sha256(ctx, sha)
+        etag = hashlib.md5(first).hexdigest()
+        version = ObjectVersion(
+            gen_uuid(),
+            now_msec(),
+            "complete",
+            {
+                "t": "inline",
+                "bytes": first,
+                "meta": {"size": len(first), "etag": etag, "headers": headers},
+            },
+        )
+        await garage.object_table.insert(Object(bucket_id, key, [version]))
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    # multi-block object
+    vid = gen_uuid()
+    ts = now_msec()
+    version0 = ObjectVersion(vid, ts, "uploading", {"t": "first_block", "vid": vid})
+    await garage.object_table.insert(Object(bucket_id, key, [version0]))
+    await garage.version_table.insert(Version(vid, bucket_id, key))
+
+    md5 = hashlib.md5()
+    sha = hashlib.sha256()
+    total = 0
+    offset = 0
+    inflight: set[asyncio.Task] = set()
+    try:
+        buf = first
+
+        async def put_one(block: bytes, block_offset: int):
+            h = blake2sum(block)
+            await garage.block_manager.rpc_put_block(h, block)
+            v = Version(vid, bucket_id, key)
+            v.blocks.put([0, block_offset], {"h": h, "s": len(block)})
+            await garage.version_table.insert(v)
+            await garage.block_ref_table.insert(BlockRef(h, vid))
+
+        async def launch(block: bytes, block_offset: int):
+            # backpressure: at most PUT_BLOCKS_MAX_PARALLEL blocks buffered
+            # in flight — the read loop stalls (and so does the client)
+            # while storage catches up (reference put.rs:42)
+            while len(inflight) >= PUT_BLOCKS_MAX_PARALLEL:
+                done, _ = await asyncio.wait(
+                    inflight, return_when=asyncio.FIRST_COMPLETED
+                )
+                for t in done:
+                    inflight.discard(t)
+                    if t.exception():
+                        raise t.exception()
+            inflight.add(asyncio.create_task(put_one(block, block_offset)))
+
+        while True:
+            while len(buf) >= block_size:
+                block, buf = buf[:block_size], buf[block_size:]
+                md5.update(block)
+                sha.update(block)
+                await launch(block, offset)
+                offset += len(block)
+                total += len(block)
+            chunk = await body.read(block_size)
+            if not chunk:
+                break
+            buf += chunk
+        if buf:
+            md5.update(buf)
+            sha.update(buf)
+            await launch(buf, offset)
+            total += len(buf)
+        if inflight:
+            await asyncio.gather(*inflight)
+        _check_sha256(ctx, sha)
+
+        etag = md5.hexdigest()
+        final = ObjectVersion(
+            vid,
+            ts,
+            "complete",
+            {
+                "t": "first_block",
+                "vid": vid,
+                "meta": {"size": total, "etag": etag, "headers": headers},
+            },
+        )
+        await garage.object_table.insert(Object(bucket_id, key, [final]))
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+    except BaseException:
+        # InterruptedCleanup (reference put.rs:217-223): mark aborted so
+        # the cascade reclaims stored blocks
+        for t in inflight:
+            t.cancel()
+        aborted = ObjectVersion(vid, ts, "aborted", {"t": "first_block", "vid": vid})
+        try:
+            await garage.object_table.insert(Object(bucket_id, key, [aborted]))
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to mark aborted upload")
+        raise
+
+
+def _pick_version(obj: Object | None) -> ObjectVersion:
+    if obj is None:
+        raise NoSuchKey("object not found")
+    v = obj.last_visible()
+    if v is None:
+        raise NoSuchKey("object not found")
+    return v
+
+
+def _meta_headers(version: ObjectVersion) -> dict[str, str]:
+    meta = version.data.get("meta", {})
+    out = {
+        "ETag": f'"{meta.get("etag", "")}"',
+        "Content-Length": str(meta.get("size", 0)),
+        "Last-Modified": _http_date(version.timestamp),
+        "x-amz-version-id": version.uuid.hex(),
+        "Accept-Ranges": "bytes",
+    }
+    for name, value in meta.get("headers", []):
+        out[name.title()] = value
+    return out
+
+
+def _http_date(ts_ms: int) -> str:
+    from datetime import datetime, timezone
+
+    dt = datetime.fromtimestamp(ts_ms / 1000, tz=timezone.utc)
+    return dt.strftime("%a, %d %b %Y %H:%M:%S GMT")
+
+
+def _check_conditionals(request, version: ObjectVersion) -> None:
+    etag = version.data.get("meta", {}).get("etag", "")
+    inm = request.headers.get("If-None-Match")
+    if inm and (inm == "*" or etag in [e.strip(' "') for e in inm.split(",")]):
+        raise ApiError("not modified", code="NotModified", status=304)
+    im = request.headers.get("If-Match")
+    if im and etag not in [e.strip(' "') for e in im.split(",")]:
+        raise PreconditionFailed("If-Match failed")
+
+
+def _parse_range(request, size: int) -> tuple[int, int] | None:
+    rng = request.headers.get("Range")
+    if not rng or not rng.startswith("bytes="):
+        return None
+    spec = rng[len("bytes="):].split(",")[0].strip()
+    start_s, _, end_s = spec.partition("-")
+    try:
+        if start_s == "":  # suffix range: last N bytes
+            n = int(end_s)
+            if n <= 0:
+                raise InvalidRange("empty suffix range")
+            return (max(0, size - n), size)
+        start = int(start_s)
+        end = int(end_s) + 1 if end_s else size
+    except ValueError as e:
+        raise InvalidRange(f"bad Range: {rng!r}") from e
+    if start >= size or start >= end:
+        raise InvalidRange(f"range {rng!r} outside object of size {size}")
+    return (start, min(end, size))
+
+
+async def handle_get_object(
+    garage, bucket_id: bytes, key: str, request, head_only: bool = False
+) -> web.StreamResponse:
+    obj = await garage.object_table.get(bucket_id, key.encode())
+    version = _pick_version(obj)
+    _check_conditionals(request, version)
+    meta = version.data.get("meta", {})
+    size = meta.get("size", 0)
+    headers = _meta_headers(version)
+
+    rng = _parse_range(request, size) if not head_only else None
+    status = 200
+    if rng is not None:
+        start, end = rng
+        headers["Content-Range"] = f"bytes {start}-{end - 1}/{size}"
+        headers["Content-Length"] = str(end - start)
+        status = 206
+
+    if head_only:
+        return web.Response(status=200, headers=headers)
+
+    if version.data.get("t") == "inline":
+        data = version.data["bytes"]
+        if rng is not None:
+            data = data[rng[0] : rng[1]]
+        return web.Response(status=status, body=data, headers=headers)
+
+    # block version: stream, prefetching one block ahead
+    vid = version.data["vid"]
+    ver = await garage.version_table.get(vid, b"")
+    if ver is None or ver.deleted.get():
+        raise NoSuchKey("version data missing")
+    blocks = ver.sorted_blocks()
+    start, end = rng if rng is not None else (0, size)
+
+    resp = web.StreamResponse(status=status, headers=headers)
+    await resp.prepare(request)
+
+    async def fetch(h):
+        return await garage.block_manager.rpc_get_block(h)
+
+    pos = 0
+    next_task: asyncio.Task | None = None
+    try:
+        wanted: list[tuple[int, int, bytes]] = []  # (blk_start, blk_end, hash)
+        for (_part, _off), blk in blocks:
+            b_start, b_end = pos, pos + blk["s"]
+            pos = b_end
+            if b_end <= start or b_start >= end:
+                continue
+            wanted.append((b_start, b_end, blk["h"]))
+        for i, (b_start, b_end, h) in enumerate(wanted):
+            data = await (next_task if next_task else fetch(h))
+            if next_task:
+                next_task = None
+            if i + 1 < len(wanted):
+                next_task = asyncio.create_task(fetch(wanted[i + 1][2]))
+            lo = max(start - b_start, 0)
+            hi = min(end, b_end) - b_start
+            await resp.write(data[lo:hi])
+        await resp.write_eof()
+    finally:
+        if next_task:
+            next_task.cancel()
+    return resp
+
+
+async def handle_delete_object(garage, bucket_id: bytes, key: str) -> web.Response:
+    obj = await garage.object_table.get(bucket_id, key.encode())
+    if obj is None or obj.last_visible() is None:
+        # deleting a non-existent object is a success in S3
+        return web.Response(status=204)
+    dm = ObjectVersion(gen_uuid(), now_msec(), "complete", {"t": "delete_marker"})
+    await garage.object_table.insert(Object(bucket_id, key, [dm]))
+    return web.Response(status=204)

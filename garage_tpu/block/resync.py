@@ -44,9 +44,14 @@ class BlockResyncManager:
 
     # --- queueing -------------------------------------------------------------
 
-    def queue_block(self, hash32: bytes, delay_ms: int = 0) -> None:
+    def queue_block(self, hash32: bytes, delay_ms: int = 0, tx=None) -> None:
+        """Pass `tx` when queueing from inside a table updated() hook."""
         when = now_msec() + delay_ms
-        self.queue.insert(when.to_bytes(8, "big") + hash32, b"")
+        key = when.to_bytes(8, "big") + hash32
+        if tx is not None:
+            tx.insert(self.queue, key, b"")
+        else:
+            self.queue.insert(key, b"")
         self._kick.set()
 
     def queue_len(self) -> int:

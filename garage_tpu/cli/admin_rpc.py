@@ -1,0 +1,243 @@
+"""Admin RPC: the operator control plane over the netapp mesh.
+
+Reference src/garage/admin/mod.rs:38-88 — the CLI connects to the daemon
+as an ephemeral authenticated peer and issues AdminRpc commands; the
+daemon executes them against its Garage instance.  Ops are msgpack
+["name", {args}] pairs on endpoint `admin/rpc`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from ..net.message import Req, Resp
+from ..rpc.layout.types import NodeRole
+from ..utils.data import hex_of
+
+logger = logging.getLogger("garage.admin")
+
+
+class AdminRpcHandler:
+    def __init__(self, garage):
+        self.garage = garage
+        ep = garage.netapp.endpoint("admin/rpc")
+        ep.set_handler(self._handle)
+
+    async def _handle(self, from_id: bytes, req: Req) -> Resp:
+        op, args = req.body[0], req.body[1] or {}
+        fn = getattr(self, f"op_{op.replace('-', '_')}", None)
+        if fn is None:
+            raise ValueError(f"unknown admin op {op!r}")
+        return Resp(await fn(args))
+
+    # --- cluster --------------------------------------------------------------
+
+    async def op_status(self, args) -> Any:
+        sysd = self.garage.system
+        h = sysd.health()
+        peers = []
+        for pid, state in sysd.peering.peer_states().items():
+            st = sysd.node_status.get(pid)
+            peers.append(
+                {
+                    "id": hex_of(pid),
+                    "state": state,
+                    "hostname": st[0].hostname if st else "?",
+                }
+            )
+        layout = self.garage.layout_manager.history
+        cur = layout.current()
+        roles = {
+            hex_of(n): {
+                "zone": r.zone,
+                "capacity": r.capacity,
+                "tags": r.tags,
+            }
+            for n, r in cur.roles.items()
+        }
+        return {
+            "node_id": hex_of(sysd.id),
+            "health": h.__dict__,
+            "peers": peers,
+            "layout_version": cur.version,
+            "roles": roles,
+            "staged": [
+                [hex_of(bytes(k)), v]
+                for k, v in layout.staging.roles.items()
+            ],
+        }
+
+    async def op_connect(self, args) -> Any:
+        nid = bytes.fromhex(args["node"])
+        addr = (args["host"], int(args["port"]))
+        await self.garage.netapp.connect(addr, nid)
+        return "connected"
+
+    # --- layout ---------------------------------------------------------------
+
+    async def op_layout_assign(self, args) -> Any:
+        node = bytes.fromhex(args["node"])
+        if args.get("gateway"):
+            role = NodeRole(zone=args["zone"], capacity=None, tags=args.get("tags", []))
+        else:
+            role = NodeRole(
+                zone=args["zone"],
+                capacity=int(args["capacity"]),
+                tags=args.get("tags", []),
+            )
+        self.garage.layout_manager.stage_role(node, role)
+        return "staged"
+
+    async def op_layout_remove(self, args) -> Any:
+        self.garage.layout_manager.stage_role(bytes.fromhex(args["node"]), None)
+        return "staged removal"
+
+    async def op_layout_apply(self, args) -> Any:
+        lv, report = self.garage.layout_manager.apply_staged(args.get("version"))
+        return {"version": lv.version, "report": report}
+
+    async def op_layout_revert(self, args) -> Any:
+        self.garage.layout_manager.revert_staged()
+        return "reverted"
+
+    async def op_layout_show(self, args) -> Any:
+        layout = self.garage.layout_manager.history
+        cur = layout.current()
+        return {
+            "version": cur.version,
+            "roles": {
+                hex_of(n): [r.zone, r.capacity, r.tags]
+                for n, r in cur.roles.items()
+            },
+            "staged": [
+                [hex_of(bytes(k)), v] for k, v in layout.staging.roles.items()
+            ],
+            "partition_size": cur.partition_size,
+        }
+
+    # --- buckets --------------------------------------------------------------
+
+    async def op_bucket_list(self, args) -> Any:
+        out = []
+        for b in await self.garage.helper.list_buckets():
+            names = [n for n, v in b.params().aliases.items() if v]
+            out.append({"id": hex_of(b.id), "aliases": names})
+        return out
+
+    async def op_bucket_create(self, args) -> Any:
+        bid = await self.garage.helper.create_bucket(args["name"])
+        return {"id": hex_of(bid)}
+
+    async def op_bucket_delete(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["name"])
+        await self.garage.helper.delete_bucket(bid)
+        return "deleted"
+
+    async def op_bucket_info(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["name"])
+        b = await self.garage.helper.get_bucket(bid)
+        p = b.params()
+        return {
+            "id": hex_of(bid),
+            "aliases": [n for n, v in p.aliases.items() if v],
+            "website": p.website.get(),
+            "quotas": p.quotas.get(),
+        }
+
+    async def op_bucket_allow(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["bucket"])
+        await self.garage.helper.set_bucket_key_permissions(
+            bid,
+            args["key"],
+            bool(args.get("read")),
+            bool(args.get("write")),
+            bool(args.get("owner")),
+        )
+        return "granted"
+
+    async def op_bucket_deny(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["bucket"])
+        await self.garage.helper.set_bucket_key_permissions(
+            bid, args["key"], False, False, False
+        )
+        return "revoked"
+
+    # --- keys -----------------------------------------------------------------
+
+    async def op_key_new(self, args) -> Any:
+        key = await self.garage.helper.create_key(args.get("name", ""))
+        if args.get("allow_create_bucket"):
+            key.params().allow_create_bucket.update(True)
+            await self.garage.key_table.insert(key)
+        return {"key_id": key.key_id, "secret_key": key.secret()}
+
+    async def op_key_list(self, args) -> Any:
+        return [
+            {"key_id": k.key_id, "name": k.params().name.get()}
+            for k in await self.garage.helper.list_keys()
+        ]
+
+    async def op_key_info(self, args) -> Any:
+        k = await self.garage.helper.get_key(args["key"])
+        p = k.params()
+        return {
+            "key_id": k.key_id,
+            "name": p.name.get(),
+            "secret_key": p.secret_key if args.get("show_secret") else "(hidden)",
+            "buckets": [
+                hex_of(bytes(b)) for b, _perm in p.authorized_buckets.items()
+            ],
+        }
+
+    async def op_key_delete(self, args) -> Any:
+        await self.garage.helper.delete_key(args["key"])
+        return "deleted"
+
+    # --- workers / repair -----------------------------------------------------
+
+    async def op_worker_list(self, args) -> Any:
+        return [
+            {
+                "id": wid,
+                "name": info.name,
+                "state": info.state,
+                "errors": info.errors,
+                "info": info.progress,
+            }
+            for wid, info in self.garage.bg.worker_info().items()
+        ]
+
+    async def op_repair(self, args) -> Any:
+        what = args.get("what", "blocks")
+        from ..block.repair import RebalanceWorker, RepairWorker
+
+        if what == "blocks":
+            self.garage.bg.spawn(RepairWorker(self.garage.block_manager))
+        elif what == "rebalance":
+            self.garage.bg.spawn(RebalanceWorker(self.garage.block_manager))
+        elif what == "tables":
+            for t in self.garage.tables:
+                await t.syncer.sync_all_partitions()
+        else:
+            raise ValueError(f"unknown repair target {what!r}")
+        return f"repair {what} launched"
+
+    async def op_stats(self, args) -> Any:
+        g = self.garage
+        return {
+            "db_engine": g.db.engine,
+            "tables": {
+                t.schema.table_name: {
+                    "entries": len(t.data.store),
+                    "merkle_todo": len(t.data.merkle_todo),
+                    "gc_todo": len(t.data.gc_todo),
+                }
+                for t in g.tables
+            },
+            "blocks": {
+                "rc_entries": len(g.block_manager.rc.tree),
+                "resync_queue": g.block_manager.resync.queue_len(),
+                "resync_errors": g.block_manager.resync.errors_len(),
+            },
+        }

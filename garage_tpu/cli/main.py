@@ -1,0 +1,318 @@
+"""garage-tpu CLI + daemon (reference src/garage/main.rs + cli/).
+
+    python -m garage_tpu.cli server -c garage.toml
+    python -m garage_tpu.cli -c garage.toml status
+    python -m garage_tpu.cli -c garage.toml node id
+    python -m garage_tpu.cli -c garage.toml layout assign <node> -z dc1 -c 100G
+    python -m garage_tpu.cli -c garage.toml layout apply / show / revert
+    python -m garage_tpu.cli -c garage.toml bucket create/list/info/delete/allow/deny
+    python -m garage_tpu.cli -c garage.toml key new/list/info/delete
+    python -m garage_tpu.cli -c garage.toml worker list
+    python -m garage_tpu.cli -c garage.toml repair blocks|rebalance|tables
+    python -m garage_tpu.cli -c garage.toml stats
+
+Non-server commands connect to the running daemon as an ephemeral
+authenticated peer (reference main.rs:281-324) and issue AdminRpc ops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+from ..format_table import format_table
+from ..model.garage import Garage, _parse_addr
+from ..net.handshake import gen_node_key
+from ..net.netapp import NetApp
+from ..utils.config import read_config
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="garage-tpu")
+    ap.add_argument(
+        "-c", "--config",
+        default=os.environ.get("GARAGE_CONFIG_FILE", "/etc/garage.toml"),
+    )
+    ap.add_argument("--json", action="store_true", help="raw JSON output")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("server", help="run the storage daemon")
+    sub.add_parser("status")
+    sub.add_parser("stats")
+    node = sub.add_parser("node")
+    node.add_argument("node_cmd", choices=["id", "connect"])
+    node.add_argument("arg", nargs="?")
+
+    lay = sub.add_parser("layout")
+    lay_sub = lay.add_subparsers(dest="layout_cmd", required=True)
+    asg = lay_sub.add_parser("assign")
+    asg.add_argument("node")
+    asg.add_argument("-z", "--zone", required=True)
+    asg.add_argument("-s", "--capacity", help="e.g. 100G (omit for gateway)")
+    asg.add_argument("-g", "--gateway", action="store_true")
+    asg.add_argument("-t", "--tags", nargs="*", default=[])
+    rmv = lay_sub.add_parser("remove")
+    rmv.add_argument("node")
+    app = lay_sub.add_parser("apply")
+    app.add_argument("--version", type=int)
+    lay_sub.add_parser("show")
+    lay_sub.add_parser("revert")
+
+    bkt = sub.add_parser("bucket")
+    bkt_sub = bkt.add_subparsers(dest="bucket_cmd", required=True)
+    for c in ["create", "delete", "info"]:
+        p = bkt_sub.add_parser(c)
+        p.add_argument("name")
+    bkt_sub.add_parser("list")
+    alw = bkt_sub.add_parser("allow")
+    alw.add_argument("bucket")
+    alw.add_argument("--key", required=True)
+    alw.add_argument("--read", action="store_true")
+    alw.add_argument("--write", action="store_true")
+    alw.add_argument("--owner", action="store_true")
+    dny = bkt_sub.add_parser("deny")
+    dny.add_argument("bucket")
+    dny.add_argument("--key", required=True)
+
+    key = sub.add_parser("key")
+    key_sub = key.add_subparsers(dest="key_cmd", required=True)
+    knew = key_sub.add_parser("new")
+    knew.add_argument("--name", default="")
+    knew.add_argument("--allow-create-bucket", action="store_true")
+    key_sub.add_parser("list")
+    kinf = key_sub.add_parser("info")
+    kinf.add_argument("key")
+    kinf.add_argument("--show-secret", action="store_true")
+    kdel = key_sub.add_parser("delete")
+    kdel.add_argument("key")
+
+    wrk = sub.add_parser("worker")
+    wrk.add_argument("worker_cmd", choices=["list"])
+    rep = sub.add_parser("repair")
+    rep.add_argument("what", choices=["blocks", "rebalance", "tables"])
+
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=os.environ.get("GARAGE_LOG", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.cmd == "server":
+        return asyncio.run(run_server(args.config))
+    return asyncio.run(run_cli(args))
+
+
+async def run_server(config_path: str) -> None:
+    """Daemon boot (reference src/garage/server.rs:30)."""
+    from ..api.s3.api_server import S3ApiServer
+    from .admin_rpc import AdminRpcHandler
+
+    config = read_config(config_path)
+    garage = Garage(config)
+    await garage.start()
+    AdminRpcHandler(garage)
+    garage.spawn_workers()
+
+    s3 = None
+    if config.s3_api.api_bind_addr:
+        s3 = S3ApiServer(garage)
+        host, port = _parse_addr(config.s3_api.api_bind_addr)
+        await s3.start(host, port)
+
+    print(f"garage-tpu node {garage.node_id.hex()} up", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("shutting down...", flush=True)
+    if s3:
+        await s3.stop()
+    await garage.stop()
+
+
+async def run_cli(args) -> None:
+    config = read_config(args.config)
+    if args.cmd == "node" and args.node_cmd == "id":
+        # local: read the node key from metadata_dir
+        from ..net.handshake import node_id_of
+
+        with open(os.path.join(config.metadata_dir, "node_key"), "rb") as f:
+            nid = node_id_of(f.read())
+        addr = config.rpc_public_addr or config.rpc_bind_addr
+        print(f"{nid.hex()}@{addr}")
+        return
+
+    # connect to the daemon as an ephemeral peer
+    network_key = bytes.fromhex(config.rpc_secret.ljust(64, "0"))[:32]
+    app = NetApp(network_key, gen_node_key())
+    addr = _parse_addr(config.rpc_public_addr or config.rpc_bind_addr)
+    if addr[0] == "0.0.0.0":
+        addr = ("127.0.0.1", addr[1])
+    daemon_id = await app.connect(addr)
+    ep = app.endpoint("admin/rpc")
+
+    async def call(op, op_args=None):
+        resp = await ep.call(daemon_id, [op, op_args or {}], timeout=120.0)
+        return resp.body
+
+    try:
+        out = await dispatch(args, call, config)
+        if out is not None:
+            print(out)
+    finally:
+        await app.shutdown()
+
+
+async def dispatch(args, call, config) -> str | None:
+    from ..utils.config import _parse_capacity
+
+    jd = (lambda x: json.dumps(x, indent=2, default=repr)) if args.json else None
+
+    if args.cmd == "status":
+        st = await call("status")
+        if jd:
+            return jd(st)
+        rows = ["==== NODE ====", f"node id\t{st['node_id']}"]
+        h = st["health"]
+        rows += [
+            f"cluster health\t{h['status']}",
+            f"nodes\t{h['connected_nodes']}/{h['known_nodes']} connected",
+            f"partitions ok\t{h['partitions_quorum']}/{h['partitions']}",
+            f"layout version\t{st['layout_version']}",
+        ]
+        out = format_table(rows) + "\n\n==== PEERS ====\n"
+        prow = ["id\tstate\thostname"]
+        for p in st["peers"]:
+            prow.append(f"{p['id'][:16]}\t{p['state']}\t{p['hostname']}")
+        out += format_table(prow)
+        if st["roles"]:
+            out += "\n\n==== ROLES ====\n"
+            rrow = ["id\tzone\tcapacity"]
+            for nid, r in st["roles"].items():
+                cap = "gateway" if r["capacity"] is None else str(r["capacity"])
+                rrow.append(f"{nid[:16]}\t{r['zone']}\t{cap}")
+            out += format_table(rrow)
+        return out
+
+    if args.cmd == "stats":
+        return json.dumps(await call("stats"), indent=2, default=repr)
+
+    if args.cmd == "node" and args.node_cmd == "connect":
+        nid, _, hostport = args.arg.partition("@")
+        host, _, port = hostport.rpartition(":")
+        return await call("connect", {"node": nid, "host": host, "port": int(port)})
+
+    if args.cmd == "layout":
+        lc = args.layout_cmd
+        if lc == "assign":
+            a = {
+                "node": args.node,
+                "zone": args.zone,
+                "tags": args.tags,
+                "gateway": args.gateway,
+            }
+            if not args.gateway:
+                if not args.capacity:
+                    return "error: -s/--capacity required (or -g for gateway)"
+                a["capacity"] = _parse_capacity(args.capacity)
+            return str(await call("layout-assign", a))
+        if lc == "remove":
+            return str(await call("layout-remove", {"node": args.node}))
+        if lc == "apply":
+            r = await call("layout-apply", {"version": args.version})
+            return f"layout version {r['version']} applied:\n" + "\n".join(r["report"])
+        if lc == "revert":
+            return str(await call("layout-revert"))
+        if lc == "show":
+            r = await call("layout-show")
+            if jd:
+                return jd(r)
+            rows = [f"version\t{r['version']}", f"partition size\t{r['partition_size']}"]
+            for nid, (zone, cap, tags) in r["roles"].items():
+                rows.append(
+                    f"{nid[:16]}\t{zone}\t{'gateway' if cap is None else cap}\t{','.join(tags)}"
+                )
+            if r["staged"]:
+                rows.append("-- staged changes --")
+                for nid, role in r["staged"]:
+                    rows.append(f"{nid[:16]}\t{role}")
+            return format_table(rows)
+
+    if args.cmd == "bucket":
+        bc = args.bucket_cmd
+        if bc == "list":
+            bs = await call("bucket-list")
+            return format_table(
+                ["id\taliases"]
+                + [f"{b['id'][:16]}\t{','.join(b['aliases'])}" for b in bs]
+            )
+        if bc == "create":
+            return str(await call("bucket-create", {"name": args.name}))
+        if bc == "delete":
+            return str(await call("bucket-delete", {"name": args.name}))
+        if bc == "info":
+            return json.dumps(
+                await call("bucket-info", {"name": args.name}), indent=2, default=repr
+            )
+        if bc == "allow":
+            return str(
+                await call(
+                    "bucket-allow",
+                    {
+                        "bucket": args.bucket,
+                        "key": args.key,
+                        "read": args.read,
+                        "write": args.write,
+                        "owner": args.owner,
+                    },
+                )
+            )
+        if bc == "deny":
+            return str(await call("bucket-deny", {"bucket": args.bucket, "key": args.key}))
+
+    if args.cmd == "key":
+        kc = args.key_cmd
+        if kc == "new":
+            r = await call(
+                "key-new",
+                {"name": args.name, "allow_create_bucket": args.allow_create_bucket},
+            )
+            return f"Key ID: {r['key_id']}\nSecret key: {r['secret_key']}"
+        if kc == "list":
+            ks = await call("key-list")
+            return format_table(
+                ["key id\tname"] + [f"{k['key_id']}\t{k['name']}" for k in ks]
+            )
+        if kc == "info":
+            return json.dumps(
+                await call("key-info", {"key": args.key, "show_secret": args.show_secret}),
+                indent=2,
+                default=repr,
+            )
+        if kc == "delete":
+            return str(await call("key-delete", {"key": args.key}))
+
+    if args.cmd == "worker":
+        ws = await call("worker-list")
+        rows = ["id\tname\tstate\terrors\tinfo"]
+        for w in ws:
+            rows.append(
+                f"{w['id']}\t{w['name']}\t{w['state']}\t{w['errors']}\t{w['info']}"
+            )
+        return format_table(rows)
+
+    if args.cmd == "repair":
+        return str(await call("repair", {"what": args.what}))
+
+    return None
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Global bucket aliases: name -> bucket id (full-copy;
+reference src/model/bucket_alias_table.rs)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..table.schema import TableSchema
+from ..utils.crdt import Lww
+
+
+class BucketAlias:
+    def __init__(self, name: str, state: Lww):
+        self.name = name
+        self.state = state  # Lww[bucket_id bytes | None]
+
+    @classmethod
+    def new(cls, name: str, bucket_id: bytes | None) -> "BucketAlias":
+        if not valid_bucket_name(name):
+            raise ValueError(f"invalid bucket name {name!r}")
+        return cls(name, Lww(bucket_id))
+
+    def merge(self, other: "BucketAlias") -> None:
+        self.state.merge(other.state)
+
+    def to_obj(self) -> Any:
+        return [self.name, self.state.to_obj()]
+
+
+class BucketAliasTable(TableSchema):
+    table_name = "bucket_alias"
+
+    def entry_partition_key(self, e: BucketAlias) -> bytes:
+        return e.name.encode()
+
+    def entry_sort_key(self, e: BucketAlias) -> bytes:
+        return b""
+
+    def decode_entry(self, obj: Any) -> BucketAlias:
+        v = Lww.from_obj(obj[1])
+        if v.value is not None:
+            v.value = bytes(v.value)
+        return BucketAlias(obj[0], v)
+
+
+def valid_bucket_name(name: str) -> bool:
+    """AWS-compatible bucket naming (reference bucket_alias_table.rs)."""
+    return (
+        3 <= len(name) <= 63
+        and all(c.islower() or c.isdigit() or c in ".-" for c in name)
+        and name[0] not in ".-"
+        and name[-1] not in ".-"
+        and ".." not in name
+        and not all(c.isdigit() or c == "." for c in name)
+    )

@@ -1,0 +1,180 @@
+"""Garage: the composition root wiring every subsystem
+(reference src/model/garage.rs:95-320).
+
+Boot order: config -> db -> netapp -> layout manager -> system -> block
+manager -> tables (with their reactive cross-links) -> background workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from ..block.codec import get_codec
+from ..block.manager import BlockManager
+from ..db import open_db
+from ..net.handshake import gen_node_key, node_id_of
+from ..net.netapp import NetApp
+from ..rpc.layout.manager import LayoutManager, PersistedLayout
+from ..rpc.replication_mode import ReplicationMode
+from ..rpc.rpc_helper import RpcHelper
+from ..rpc.system import PersistedPeers, System
+from ..table.replication import TableFullReplication, TableShardedReplication
+from ..table.table import Table
+from ..utils.background import BackgroundRunner
+from ..utils.config import Config
+from ..utils.persister import Persister
+from .bucket_alias_table import BucketAliasTable
+from .bucket_table import BucketTable
+from .key_table import KeyTable
+from .s3.block_ref_table import BlockRefTable
+from .s3.object_table import ObjectTable
+from .s3.version_table import VersionTable
+
+logger = logging.getLogger("garage")
+
+
+def _parse_addr(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host.strip("[]") or "0.0.0.0", int(port))
+
+
+def _parse_bootstrap(entries: list[str]) -> list[tuple[bytes, tuple[str, int]]]:
+    """'hexid@host:port' entries (reference: node id @ address)."""
+    out = []
+    for e in entries:
+        nid, _, addr = e.partition("@")
+        out.append((bytes.fromhex(nid), _parse_addr(addr)))
+    return out
+
+
+class Garage:
+    def __init__(self, config: Config):
+        self.config = config
+        meta = config.metadata_dir
+        os.makedirs(meta, exist_ok=True)
+
+        # node identity persists across restarts
+        keyfile = os.path.join(meta, "node_key")
+        if os.path.exists(keyfile):
+            with open(keyfile, "rb") as f:
+                node_key = f.read()
+        else:
+            node_key = gen_node_key()
+            with open(keyfile, "wb") as f:
+                f.write(node_key)
+            os.chmod(keyfile, 0o600)
+        self.node_id = node_id_of(node_key)
+
+        if not config.rpc_secret:
+            raise ValueError("rpc_secret is required")
+        network_key = bytes.fromhex(config.rpc_secret.ljust(64, "0"))[:32]
+
+        self.db = open_db(
+            os.path.join(meta, "db"),
+            engine=config.db_engine,
+            fsync=config.metadata_fsync,
+        )
+        self.netapp = NetApp(network_key, node_key)
+
+        self.replication_mode = ReplicationMode(
+            config.replication_factor, config.consistency_mode
+        )
+        self.layout_manager = LayoutManager(
+            self.node_id,
+            config.replication_factor,
+            persister=Persister(meta, "cluster_layout", PersistedLayout),
+        )
+        public_addr = (
+            _parse_addr(config.rpc_public_addr) if config.rpc_public_addr else None
+        )
+        self.system = System(
+            self.netapp,
+            self.layout_manager,
+            self.replication_mode,
+            bootstrap=_parse_bootstrap(config.bootstrap_peers),
+            peer_persister=Persister(meta, "peer_list", PersistedPeers),
+            metadata_dir=meta,
+            data_dirs=[d.path for d in config.data_dir],
+            public_addr=public_addr,
+        )
+        self.helper_rpc = RpcHelper(
+            self.node_id, self.system.peering,
+            default_timeout=config.rpc_timeout_msec / 1000.0,
+        )
+
+        codec = get_codec(
+            config.ec_params(),
+            tpu_enable=config.tpu.enable,
+            platform=config.tpu.platform,
+        )
+        self.block_manager = BlockManager(
+            self.system,
+            self.helper_rpc,
+            self.db,
+            config.data_dir,
+            meta,
+            compression_level=config.compression_level,
+            codec=codec,
+            data_fsync=config.data_fsync,
+        )
+
+        # tables, wired with their reactive cross-links
+        sharded = TableShardedReplication(self.system)
+        fullcopy = TableFullReplication(self.system)
+
+        self.block_ref_schema = BlockRefTable(self.block_manager)
+        self.block_ref_table = Table(
+            self.system, self.helper_rpc, self.db, self.block_ref_schema, sharded
+        )
+        self.version_schema = VersionTable(self.block_ref_table)
+        self.version_table = Table(
+            self.system, self.helper_rpc, self.db, self.version_schema, sharded
+        )
+        self.object_schema = ObjectTable(self.version_table)
+        self.object_table = Table(
+            self.system, self.helper_rpc, self.db, self.object_schema, sharded
+        )
+        self.bucket_table = Table(
+            self.system, self.helper_rpc, self.db, BucketTable(), fullcopy
+        )
+        self.bucket_alias_table = Table(
+            self.system, self.helper_rpc, self.db, BucketAliasTable(), fullcopy
+        )
+        self.key_table = Table(
+            self.system, self.helper_rpc, self.db, KeyTable(), fullcopy
+        )
+        self.tables = [
+            self.object_table,
+            self.version_table,
+            self.block_ref_table,
+            self.bucket_table,
+            self.bucket_alias_table,
+            self.key_table,
+        ]
+
+        from .helper import GarageHelper
+
+        self.helper = GarageHelper(self)
+        self.bg = BackgroundRunner()
+        self._started = False
+
+    # --- lifecycle -----------------------------------------------------------
+
+    async def start(self) -> None:
+        host, port = _parse_addr(self.config.rpc_bind_addr)
+        await self.netapp.listen(host, port)
+        await self.system.start()
+        self._started = True
+
+    def spawn_workers(self) -> None:
+        for t in self.tables:
+            t.spawn_workers(self.bg)
+        self.block_manager.spawn_workers(self.bg)
+
+    async def stop(self) -> None:
+        await self.bg.shutdown()
+        await self.system.stop()
+        await self.netapp.shutdown()
+        self.db.close()

@@ -1,0 +1,142 @@
+"""Admin helpers: bucket/key lifecycle with invariant checks.
+
+Reference src/model/helper/{bucket,key,locked}.rs — admin mutations that
+touch several entries (bucket + alias + key permissions) are serialized
+through one lock per node; cross-node races converge by CRDT (two
+concurrent create-bucket calls for the same alias: the LWW alias points to
+one winner, the loser's bucket remains unaliased and can be cleaned up).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..utils.crdt import Deletable, Lww
+from ..utils.data import gen_uuid
+from ..utils.error import Error
+from .bucket_alias_table import BucketAlias, valid_bucket_name
+from .bucket_table import Bucket
+from .key_table import Key
+from .permission import BucketKeyPerm
+from .s3.object_table import Object
+
+
+class GarageHelper:
+    def __init__(self, garage):
+        self.garage = garage
+        self.lock = asyncio.Lock()
+
+    # --- resolution -----------------------------------------------------------
+
+    async def resolve_bucket(self, name: str, key: Key | None = None) -> bytes:
+        """Bucket name -> id: local alias of the key first, then global
+        alias (reference helper/bucket.rs resolve_bucket)."""
+        if key is not None and key.params() is not None:
+            local = key.params().local_aliases.get(name)
+            if local:
+                return bytes(local)
+        alias = await self.garage.bucket_alias_table.get(name.encode(), b"")
+        if alias is not None and alias.state.get() is not None:
+            return bytes(alias.state.get())
+        raise Error(f"bucket {name!r} not found")
+
+    async def get_bucket(self, bucket_id: bytes) -> Bucket:
+        b = await self.garage.bucket_table.get(bucket_id, b"")
+        if b is None or b.is_deleted():
+            raise Error(f"bucket {bucket_id.hex()[:16]} not found")
+        return b
+
+    async def get_key(self, key_id: str) -> Key:
+        k = await self.garage.key_table.get(key_id.encode(), b"")
+        if k is None or k.is_deleted():
+            raise Error(f"key {key_id} not found")
+        return k
+
+    # --- bucket lifecycle -----------------------------------------------------
+
+    async def create_bucket(self, name: str) -> bytes:
+        if not valid_bucket_name(name):
+            raise Error(f"invalid bucket name {name!r}")
+        async with self.lock:
+            existing = await self.garage.bucket_alias_table.get(name.encode(), b"")
+            if existing is not None and existing.state.get() is not None:
+                raise Error(f"bucket {name!r} already exists")
+            bucket = Bucket.new(gen_uuid())
+            bucket.params().aliases.update_in_place(name, True)
+            await self.garage.bucket_table.insert(bucket)
+            if existing is not None:
+                existing.state.update(bucket.id)
+                await self.garage.bucket_alias_table.insert(existing)
+            else:
+                await self.garage.bucket_alias_table.insert(
+                    BucketAlias.new(name, bucket.id)
+                )
+            return bucket.id
+
+    async def delete_bucket(self, bucket_id: bytes) -> None:
+        """Delete an EMPTY bucket and its aliases."""
+        async with self.lock:
+            bucket = await self.get_bucket(bucket_id)
+            objs = await self.garage.object_table.get_range(
+                bucket_id, None, "visible", 1
+            )
+            if objs:
+                raise Error("bucket is not empty")
+            params = bucket.params()
+            for name, v in params.aliases.items():
+                if v:
+                    alias = await self.garage.bucket_alias_table.get(name.encode(), b"")
+                    if alias and alias.state.get() == bucket_id:
+                        alias.state.update(None)
+                        await self.garage.bucket_alias_table.insert(alias)
+            bucket.state = Deletable.deleted()
+            await self.garage.bucket_table.insert(bucket)
+
+    async def list_buckets(self) -> list[Bucket]:
+        out = []
+        aliases = await self.garage.bucket_alias_table.get_range(b"", limit=10000)
+        seen = set()
+        for a in aliases:
+            bid = a.state.get()
+            if bid is not None and bytes(bid) not in seen:
+                seen.add(bytes(bid))
+                try:
+                    out.append(await self.get_bucket(bytes(bid)))
+                except Error:
+                    pass
+        return out
+
+    # --- key lifecycle --------------------------------------------------------
+
+    async def create_key(self, name: str = "") -> Key:
+        key = Key.new(name)
+        await self.garage.key_table.insert(key)
+        return key
+
+    async def delete_key(self, key_id: str) -> None:
+        async with self.lock:
+            key = await self.get_key(key_id)
+            key.state = Deletable.deleted()
+            await self.garage.key_table.insert(key)
+
+    async def list_keys(self) -> list[Key]:
+        ks = await self.garage.key_table.get_range(b"", limit=10000)
+        return [k for k in ks if not k.is_deleted()]
+
+    async def set_bucket_key_permissions(
+        self, bucket_id: bytes, key_id: str, read: bool, write: bool, owner: bool
+    ) -> None:
+        from ..utils.time_util import now_msec
+
+        async with self.lock:
+            key = await self.get_key(key_id)
+            await self.get_bucket(bucket_id)  # must exist
+            perm = BucketKeyPerm(now_msec(), read, write, owner)
+            key.params().authorized_buckets.update_in_place(bucket_id, perm.to_obj())
+            await self.garage.key_table.insert(key)
+
+    # --- object listing (used by delete_bucket and the CLI) -------------------
+
+    async def bucket_is_empty(self, bucket_id: bytes) -> bool:
+        objs = await self.garage.object_table.get_range(bucket_id, None, "visible", 1)
+        return not objs

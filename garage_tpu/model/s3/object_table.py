@@ -1,0 +1,157 @@
+"""Object table: the S3 namespace (reference src/model/s3/object_table.rs).
+
+One entry per (bucket_id, key).  An entry holds a list of ObjectVersions,
+each identified by (uuid, timestamp):
+
+  state: "uploading" | "complete" | "aborted"
+  data:  {"t": "inline", "meta": {...}, "bytes": ...}
+       | {"t": "first_block", "meta": {...}, "vid": version_uuid}
+       | {"t": "delete_marker"}
+  meta:  {"size": int, "etag": str, "headers": [[name, value]...]}
+
+CRDT merge (object_table.rs:26-93): union of versions by (uuid, ts) with
+per-version state merge (aborted wins over anything; complete wins over
+uploading), then prune: drop everything strictly older than the newest
+"complete-or-delete-marker" version except still-uploading versions (the
+in-flight multipart uploads).  The `updated()` hook marks versions that
+disappeared (or aborted) as deleted in the version table, cascading to
+block refs -> rc decrements.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ...table.schema import TableSchema
+
+STATE_ORDER = {"uploading": 0, "complete": 1, "aborted": 2}  # aborted is terminal
+
+
+class ObjectVersion:
+    __slots__ = ("uuid", "timestamp", "state", "data")
+
+    def __init__(self, uuid: bytes, timestamp: int, state: str, data: dict):
+        self.uuid = uuid
+        self.timestamp = timestamp
+        self.state = state
+        self.data = data
+
+    def cmp_key(self) -> tuple[int, bytes]:
+        return (self.timestamp, self.uuid)
+
+    def is_complete_or_dm(self) -> bool:
+        return self.state == "complete"
+
+    def is_data_block(self) -> bool:
+        return self.state == "complete" and self.data.get("t") == "first_block"
+
+    def to_obj(self) -> Any:
+        return [self.uuid, self.timestamp, self.state, self.data]
+
+    @classmethod
+    def from_obj(cls, obj: Any) -> "ObjectVersion":
+        data = dict(obj[3])
+        if "bytes" in data:
+            data["bytes"] = bytes(data["bytes"])
+        if "vid" in data:
+            data["vid"] = bytes(data["vid"])
+        return cls(bytes(obj[0]), int(obj[1]), obj[2], data)
+
+
+class Object:
+    def __init__(self, bucket_id: bytes, key: str, versions: list[ObjectVersion]):
+        self.bucket_id = bucket_id
+        self.key = key
+        self.versions = sorted(versions, key=lambda v: v.cmp_key())
+
+    def merge(self, other: "Object") -> None:
+        byid: dict[bytes, ObjectVersion] = {v.uuid: v for v in self.versions}
+        for v in other.versions:
+            cur = byid.get(v.uuid)
+            if cur is None:
+                byid[v.uuid] = v
+            elif STATE_ORDER[v.state] > STATE_ORDER[cur.state]:
+                byid[v.uuid] = v
+        versions = sorted(byid.values(), key=lambda v: v.cmp_key())
+        # prune: find newest complete version; drop older non-uploading ones
+        # and all aborted ones
+        newest_complete = None
+        for v in versions:
+            if v.is_complete_or_dm():
+                newest_complete = v
+        out = []
+        for v in versions:
+            if v.state == "aborted":
+                continue  # aborted versions vanish (cascade deletes them)
+            if (
+                newest_complete is not None
+                and v.cmp_key() < newest_complete.cmp_key()
+                and v.state == "complete"
+            ):
+                continue
+            out.append(v)
+        self.versions = out
+
+    def last_complete(self) -> ObjectVersion | None:
+        last = None
+        for v in self.versions:
+            if v.state == "complete":
+                last = v
+        return last
+
+    def last_visible(self) -> ObjectVersion | None:
+        """Newest complete version that is not a delete marker."""
+        v = self.last_complete()
+        if v is None or v.data.get("t") == "delete_marker":
+            return None
+        return v
+
+    def to_obj(self) -> Any:
+        return [self.bucket_id, self.key, [v.to_obj() for v in self.versions]]
+
+
+class ObjectTable(TableSchema):
+    table_name = "object"
+
+    def __init__(self, version_table=None):
+        self.version_table = version_table  # set by Garage after wiring
+
+    def entry_partition_key(self, e: Object) -> bytes:
+        return e.bucket_id
+
+    def entry_sort_key(self, e: Object) -> bytes:
+        return e.key.encode()
+
+    def decode_entry(self, obj: Any) -> Object:
+        return Object(
+            bytes(obj[0]), obj[1], [ObjectVersion.from_obj(v) for v in obj[2]]
+        )
+
+    def merge_entries(self, a: Object, b: Object) -> Object:
+        a.merge(b)
+        return a
+
+    def is_tombstone(self, e: Object) -> bool:
+        # an object whose only content is a delete marker is a tombstone
+        return len(e.versions) == 1 and e.versions[0].data.get("t") == "delete_marker"
+
+    def matches_filter(self, e: Object, filt) -> bool:
+        if filt == "visible":
+            return e.last_visible() is not None
+        return True
+
+    def updated(self, tx, old: Object | None, new: Object | None) -> None:
+        """Cascade: versions that disappeared (pruned/aborted) get their
+        data deleted via the version table (reference updated() hook)."""
+        if self.version_table is None:
+            return
+        from .version_table import Version
+
+        new_uuids = {v.uuid for v in new.versions} if new is not None else set()
+        for v in old.versions if old is not None else []:
+            if v.uuid not in new_uuids and v.data.get("t") != "delete_marker":
+                # enqueue deletion (async local insert; the queue worker
+                # fans it out with quorum)
+                self.version_table.queue_insert(
+                    Version.deleted_marker(v.uuid, old.bucket_id, old.key), tx=tx
+                )

@@ -140,11 +140,16 @@ class TableData:
 
     # --- insert queue (reference table/queue.rs) ------------------------------
 
-    def queue_insert(self, entry) -> None:
+    def queue_insert(self, entry, tx: Tx | None = None) -> None:
         """Cheap local enqueue; the InsertQueueWorker batches these into
-        real quorum inserts."""
-        k = now_msec().to_bytes(8, "big") + blake2sum(self.encode(entry))[:8]
-        self.insert_queue.insert(k, self.encode(entry))
+        real quorum inserts.  Pass `tx` when called from an updated() hook
+        so the enqueue commits atomically with the triggering write."""
+        v = self.encode(entry)
+        k = now_msec().to_bytes(8, "big") + blake2sum(v)[:8]
+        if tx is not None:
+            tx.insert(self.insert_queue, k, v)
+        else:
+            self.insert_queue.insert(k, v)
         self._notify()
 
     # --- iteration (sync / gc workers) ---------------------------------------

@@ -83,10 +83,10 @@ class Table:
                 quorum=self.replication.write_quorum(),
             )
 
-    def queue_insert(self, entry) -> None:
+    def queue_insert(self, entry, tx=None) -> None:
         """Asynchronous local insert (reference table/queue.rs): cheap,
         batched into quorum writes by the InsertQueueWorker."""
-        self.data.queue_insert(entry)
+        self.data.queue_insert(entry, tx=tx)
 
     # --- reads ----------------------------------------------------------------
 

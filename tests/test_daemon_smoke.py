@@ -1,0 +1,173 @@
+"""Multi-process smoke test: real daemons + real CLI
+(reference script/dev-cluster.sh + test-smoke.sh pattern: boot a 3-node
+cluster as separate processes on localhost, configure it with the CLI
+binary, then exercise S3 with a client)."""
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RPC_SECRET = "cc" * 32
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def write_config(tmp_path, i, rpc_port, s3_port, peers):
+    d = tmp_path / f"node{i}"
+    (d / "meta").mkdir(parents=True, exist_ok=True)
+    cfg = d / "garage.toml"
+    peers_toml = ", ".join(f'"{p}"' for p in peers)
+    cfg.write_text(
+        f"""
+metadata_dir = "{d}/meta"
+data_dir = "{d}/data"
+db_engine = "sqlite"
+replication_factor = 3
+block_size = 65536
+rpc_bind_addr = "127.0.0.1:{rpc_port}"
+rpc_public_addr = "127.0.0.1:{rpc_port}"
+rpc_secret = "{RPC_SECRET}"
+bootstrap_peers = [ {peers_toml} ]
+[s3_api]
+api_bind_addr = "127.0.0.1:{s3_port}"
+s3_region = "garage"
+"""
+    )
+    return cfg
+
+
+def cli(cfg, *args, timeout=60):
+    r = subprocess.run(
+        [sys.executable, "-m", "garage_tpu.cli", "-c", str(cfg), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    if r.returncode != 0:
+        raise RuntimeError(f"cli {args} failed:\n{r.stdout}\n{r.stderr}")
+    return r.stdout.strip()
+
+
+@pytest.mark.slow
+def test_three_node_smoke(tmp_path):
+    n = 3
+    rpc_ports = [free_port() for _ in range(n)]
+    s3_ports = [free_port() for _ in range(n)]
+    cfgs = []
+    procs = []
+    try:
+        # node ids require the node_key: generate configs first, then boot
+        for i in range(n):
+            peers = [f"127.0.0.1:{rpc_ports[j]}" for j in range(n) if j != i]
+            # bootstrap needs ids; we use CLI `node id` after first boot
+            cfgs.append(write_config(tmp_path, i, rpc_ports[i], s3_ports[i], []))
+        for i in range(n):
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m", "garage_tpu.cli", "-c", str(cfgs[i]), "server"],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    cwd=REPO,
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                )
+            )
+        # wait for daemons to come up
+        deadline = time.time() + 60
+        ids = []
+        for i in range(n):
+            while True:
+                try:
+                    out = cli(cfgs[i], "node", "id")
+                    ids.append(out.split("@")[0])
+                    break
+                except (RuntimeError, subprocess.TimeoutExpired):
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+        # interconnect: node0 connects to the others
+        for j in (1, 2):
+            for _ in range(30):
+                try:
+                    cli(cfgs[0], "node", "connect", f"{ids[j]}@127.0.0.1:{rpc_ports[j]}")
+                    break
+                except RuntimeError:
+                    time.sleep(1.0)
+
+        # layout: assign all three, apply on node0
+        for i in range(n):
+            cli(cfgs[0], "layout", "assign", ids[i], "-z", f"dc{i}", "-s", "1G")
+        out = cli(cfgs[0], "layout", "apply")
+        assert "applied" in out
+
+        # create a key + bucket, grant permissions
+        out = cli(cfgs[0], "key", "new", "--name", "smoke")
+        key_id = out.split("Key ID: ")[1].splitlines()[0].strip()
+        secret = out.split("Secret key: ")[1].splitlines()[0].strip()
+        cli(cfgs[0], "bucket", "create", "smoke-bucket")
+        cli(cfgs[0], "bucket", "allow", "smoke-bucket", "--key", key_id,
+            "--read", "--write", "--owner")
+
+        # S3 traffic: put through node0, get through node2 (cross-node!)
+        from garage_tpu.api.s3.client import S3Client
+
+        async def s3_roundtrip():
+            c0 = S3Client(f"http://127.0.0.1:{s3_ports[0]}", key_id, secret)
+            c2 = S3Client(f"http://127.0.0.1:{s3_ports[2]}", key_id, secret)
+            small = b"hello from the smoke test"
+            big = os.urandom(300_000)  # ~5 blocks at 64 KiB
+            await c0.put_object("smoke-bucket", "small.txt", small)
+            await c0.put_object("smoke-bucket", "big.bin", big)
+            got_small = await c2.get_object("smoke-bucket", "small.txt")
+            got_big = await c2.get_object("smoke-bucket", "big.bin")
+            assert got_small == small
+            assert got_big == big
+            ls = await c2.list_objects_v2("smoke-bucket")
+            assert [k["key"] for k in ls["keys"]] == ["big.bin", "small.txt"]
+            return True
+
+        assert asyncio.run(s3_roundtrip())
+
+        # status shows a healthy cluster
+        status = cli(cfgs[0], "status")
+        assert "healthy" in status or "degraded" in status
+        stats = cli(cfgs[0], "stats")
+        assert "object" in stats
+
+        # kill node1: reads must still work at quorum 2/3
+        procs[1].send_signal(signal.SIGTERM)
+        procs[1].wait(timeout=15)
+
+        async def degraded_read():
+            c2 = S3Client(f"http://127.0.0.1:{s3_ports[2]}", key_id, secret)
+            return await c2.get_object("smoke-bucket", "small.txt")
+
+        assert asyncio.run(degraded_read()) == b"hello from the smoke test"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for i, p in enumerate(procs):
+            out = p.stdout.read() if p.stdout else ""
+            if out:
+                print(f"--- node{i} output ---\n{out[-3000:]}")

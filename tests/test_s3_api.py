@@ -1,0 +1,263 @@
+"""End-to-end S3 API tests: in-process single-node Garage daemon driven
+through real HTTP with SigV4 (reference src/garage/tests/ pattern, with
+the in-repo client standing in for aws-sdk-s3)."""
+
+import asyncio
+import os
+
+import pytest
+
+from garage_tpu.api.s3.api_server import S3ApiServer
+from garage_tpu.api.s3.client import S3Client, S3Error
+from garage_tpu.model.garage import Garage
+from garage_tpu.rpc.layout.types import NodeRole
+from garage_tpu.utils.config import config_from_dict
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_daemon(tmp_path, name="node0", rpc_port=0, block_size=4096):
+    cfg = config_from_dict(
+        {
+            "metadata_dir": str(tmp_path / name / "meta"),
+            "data_dir": str(tmp_path / name / "data"),
+            "db_engine": "memory",
+            "replication_factor": 1,
+            "rpc_bind_addr": f"127.0.0.1:{rpc_port}",
+            "rpc_secret": "aa" * 32,
+            "block_size": block_size,  # small blocks: multi-block tests stay fast
+            "s3_api": {"api_bind_addr": "127.0.0.1:0", "s3_region": "garage"},
+        }
+    )
+    garage = Garage(cfg)
+    await garage.start()
+    # single-node layout
+    garage.layout_manager.stage_role(
+        garage.node_id, NodeRole(zone="dc1", capacity=10**12)
+    )
+    garage.layout_manager.apply_staged()
+    garage.spawn_workers()
+    s3 = S3ApiServer(garage)
+    await s3.start("127.0.0.1", 0)
+    port = s3.runner.addresses[0][1]
+    return garage, s3, f"http://127.0.0.1:{port}"
+
+
+async def make_client(garage, endpoint) -> S3Client:
+    key = await garage.helper.create_key("test-key")
+    key.params().allow_create_bucket.update(True)
+    await garage.key_table.insert(key)
+    return S3Client(endpoint, key.key_id, key.secret())
+
+
+async def teardown(garage, s3):
+    await s3.stop()
+    await garage.stop()
+
+
+def test_bucket_lifecycle_and_objects(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("test-bucket")
+            assert await client.list_buckets() == ["test-bucket"]
+
+            # inline object (small)
+            etag = await client.put_object(
+                "test-bucket", "hello.txt", b"hello world", "text/plain"
+            )
+            assert etag
+            got = await client.get_object("test-bucket", "hello.txt")
+            assert got == b"hello world"
+            head = await client.head_object("test-bucket", "hello.txt")
+            assert head["Content-Length"] == "11"
+            assert head["Content-Type"] == "text/plain"
+            assert head["ETag"] == f'"{etag}"'
+
+            # multi-block object (block_size=4096)
+            big = os.urandom(41_000)
+            etag2 = await client.put_object("test-bucket", "dir/big.bin", big)
+            got2 = await client.get_object("test-bucket", "dir/big.bin")
+            assert got2 == big
+            import hashlib
+
+            assert etag2 == hashlib.md5(big).hexdigest()
+
+            # range reads (spanning blocks)
+            r = await client.get_object(
+                "test-bucket", "dir/big.bin", range_="bytes=4000-12000"
+            )
+            assert r == big[4000:12001]
+            r2 = await client.get_object(
+                "test-bucket", "dir/big.bin", range_="bytes=-500"
+            )
+            assert r2 == big[-500:]
+
+            # listing with prefix/delimiter
+            await client.put_object("test-bucket", "dir/two.bin", b"x")
+            ls = await client.list_objects_v2("test-bucket")
+            assert [k["key"] for k in ls["keys"]] == [
+                "dir/big.bin", "dir/two.bin", "hello.txt"
+            ]
+            ls2 = await client.list_objects_v2("test-bucket", delimiter="/")
+            assert [k["key"] for k in ls2["keys"]] == ["hello.txt"]
+            assert ls2["common_prefixes"] == ["dir/"]
+
+            # delete
+            await client.delete_object("test-bucket", "hello.txt")
+            with pytest.raises(S3Error) as ei:
+                await client.get_object("test-bucket", "hello.txt")
+            assert ei.value.code == "NoSuchKey"
+            ls3 = await client.list_objects_v2("test-bucket")
+            assert "hello.txt" not in [k["key"] for k in ls3["keys"]]
+
+            # bucket not empty
+            with pytest.raises(S3Error) as ei:
+                await client.delete_bucket("test-bucket")
+            assert ei.value.code == "BucketNotEmpty"
+            await client.delete_object("test-bucket", "dir/big.bin")
+            await client.delete_object("test-bucket", "dir/two.bin")
+            await client.delete_bucket("test-bucket")
+            assert await client.list_buckets() == []
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_auth_failures(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("authtest")
+
+            # wrong secret
+            bad = S3Client(endpoint, client.key_id, "00" * 32)
+            with pytest.raises(S3Error) as ei:
+                await bad.list_buckets()
+            assert ei.value.status == 403
+
+            # unknown key id
+            bad2 = S3Client(endpoint, "GKdeadbeefdeadbeefdeadbe", "00" * 32)
+            with pytest.raises(S3Error) as ei:
+                await bad2.list_buckets()
+            assert ei.value.status == 403
+
+            # no permission on someone else's bucket
+            other = await make_client(garage, endpoint)
+            with pytest.raises(S3Error) as ei:
+                await other.get_object("authtest", "x")
+            assert ei.value.status == 403
+
+            # unauthenticated request
+            import aiohttp
+
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(endpoint + "/authtest") as resp:
+                    assert resp.status == 403
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_tombstone_cascade_frees_blocks(tmp_path):
+    """Deleting a big object must drop the block refcounts to zero."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("cascade")
+            big = os.urandom(20_000)
+            await client.put_object("cascade", "obj", big)
+            bm = garage.block_manager
+            assert len(bm.rc.tree) >= 5  # 4096-byte blocks
+            needed = [h for h, _v in bm.rc.tree.iter_range() if bm.rc.is_needed(h)]
+            assert needed
+            await client.delete_object("cascade", "obj")
+            # cascade: object prune -> version tombstone -> block_ref
+            # tombstones -> rc decrements (queue workers involved)
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                still = [h for h in needed if bm.rc.is_needed(h)]
+                if not still:
+                    break
+            assert not still, f"{len(still)} blocks still referenced"
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_list_pagination_no_dropped_keys(tmp_path):
+    """Continuation must not drop the key at the page boundary."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("pager")
+            keys = [f"k{i:03d}" for i in range(25)]
+            for k in keys:
+                await client.put_object("pager", k, b"x")
+            got, token = [], None
+            pages = 0
+            while True:
+                ls = await client.list_objects_v2(
+                    "pager", max_keys=7, continuation_token=token
+                )
+                got += [k["key"] for k in ls["keys"]]
+                pages += 1
+                if not ls["truncated"]:
+                    break
+                token = ls["next_token"]
+            assert got == keys, f"pagination lost keys: {set(keys) - set(got)}"
+            assert pages == 4
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_put_payload_hash_enforced(tmp_path):
+    """A body that doesn't match the signed x-amz-content-sha256 must be
+    rejected, inline and multi-block."""
+
+    async def main():
+        import hashlib
+
+        import aiohttp
+
+        from garage_tpu.api.common.signature import sign_request_headers
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("hashes")
+            for body in (b"small", os.urandom(20_000)):
+                good = hashlib.sha256(body).hexdigest()
+                headers = {"host": client.host}
+                signed = sign_request_headers(
+                    "PUT", "/hashes/obj", [], headers, body,
+                    client.key_id, client.secret, "garage",
+                )
+                # tamper AFTER signing: send different bytes
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.put(
+                        endpoint + "/hashes/obj",
+                        data=body + b"tampered",
+                        headers=signed,
+                    ) as resp:
+                        text = await resp.text()
+                        # either the signature check (content-length signed)
+                        # or the payload check must reject it
+                        assert resp.status in (400, 403), text
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
