@@ -1,0 +1,275 @@
+"""Admin REST API (reference src/api/admin/api_server.rs).
+
+  GET /health            no auth: cluster health summary (for LBs)
+  GET /metrics           Prometheus text (metrics_token bearer auth)
+  GET /v1/status         cluster status
+  GET /v1/layout  POST /v1/layout  POST /v1/layout/apply|revert
+  GET/POST /v1/bucket[?id=..]  GET/POST /v1/key[?id=..]
+  POST /v1/bucket/allow|deny
+
+Bearer-token auth with admin_token (metrics_token for /metrics only).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from aiohttp import web
+
+from ...rpc.layout.types import NodeRole
+from ...utils.data import hex_of
+
+logger = logging.getLogger("garage.api.admin")
+
+
+class AdminApiServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.admin_token = garage.config.admin.admin_token
+        self.metrics_token = garage.config.admin.metrics_token
+        self.app = web.Application()
+        self.app.router.add_route("*", "/{tail:.*}", self._entry)
+        self.runner: web.AppRunner | None = None
+
+    async def start(self, host: str, port: int) -> None:
+        self.runner = web.AppRunner(self.app, access_log=None)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, host, port)
+        await site.start()
+        logger.info("admin api listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self.runner:
+            await self.runner.cleanup()
+
+    def _check_token(self, request, token: str | None) -> bool:
+        if token is None:
+            return False
+        import hmac
+
+        auth = request.headers.get("Authorization", "")
+        return hmac.compare_digest(auth, f"Bearer {token}")
+
+    async def _entry(self, request: web.Request) -> web.Response:
+        path = request.path
+        try:
+            if path == "/health":
+                return self._health()
+            if path == "/metrics":
+                if self.metrics_token and not (
+                    self._check_token(request, self.metrics_token)
+                    or self._check_token(request, self.admin_token)
+                ):
+                    return web.Response(status=403, text="forbidden")
+                return self._metrics()
+            if not self._check_token(request, self.admin_token):
+                return web.Response(status=403, text="forbidden")
+            return await self._v1(request, path)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("admin api error")
+            return web.json_response({"error": repr(e)}, status=500)
+
+    # --- public endpoints -----------------------------------------------------
+
+    def _health(self) -> web.Response:
+        h = self.garage.system.health()
+        status = 200 if h.status in ("healthy", "degraded") else 503
+        return web.json_response(h.__dict__, status=status)
+
+    def _metrics(self) -> web.Response:
+        """Prometheus exposition (metric families per layer, reference
+        doc/book/reference-manual/monitoring.md)."""
+        g = self.garage
+        h = g.system.health()
+        lines = []
+
+        def m(name, value, help_=""):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+
+        m("cluster_healthy", 1 if h.status == "healthy" else 0, "cluster health")
+        m("cluster_known_nodes", h.known_nodes)
+        m("cluster_connected_nodes", h.connected_nodes)
+        m("cluster_storage_nodes", h.storage_nodes)
+        m("cluster_storage_nodes_up", h.storage_nodes_up)
+        m("cluster_partitions_quorum", h.partitions_quorum)
+        m("cluster_partitions_all_ok", h.partitions_all_ok)
+        m("cluster_layout_version", g.layout_manager.history.current().version)
+        for t in g.tables:
+            n = t.schema.table_name
+            lines.append(f'table_size{{table_name="{n}"}} {len(t.data.store)}')
+            lines.append(
+                f'table_merkle_updater_todo_queue_length{{table_name="{n}"}} '
+                f"{len(t.data.merkle_todo)}"
+            )
+            lines.append(f'table_gc_todo_queue_length{{table_name="{n}"}} {len(t.data.gc_todo)}')
+        bm = g.block_manager
+        m("block_resync_queue_length", bm.resync.queue_len(), "blocks awaiting resync")
+        m("block_resync_errored_blocks", bm.resync.errors_len())
+        m("block_rc_entries", len(bm.rc.tree))
+        for wid, info in g.bg.worker_info().items():
+            lines.append(
+                f'worker_errors{{worker="{info.name}"}} {info.errors}'
+            )
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    # --- v1 admin -------------------------------------------------------------
+
+    async def _v1(self, request, path) -> web.Response:
+        g = self.garage
+        if path == "/v1/status" and request.method == "GET":
+            h = g.system.health()
+            cur = g.layout_manager.history.current()
+            nodes = []
+            for nid in set(
+                list(cur.roles.keys()) + [g.node_id] + list(g.system.peering.peers.keys())
+            ):
+                role = cur.roles.get(nid)
+                nodes.append(
+                    {
+                        "id": hex_of(nid),
+                        "role": {
+                            "zone": role.zone,
+                            "capacity": role.capacity,
+                            "tags": role.tags,
+                        }
+                        if role
+                        else None,
+                        "isUp": nid == g.node_id or g.netapp.is_connected(nid),
+                    }
+                )
+            return web.json_response(
+                {
+                    "node": hex_of(g.node_id),
+                    "garageVersion": "garage-tpu/0.1.0",
+                    "layoutVersion": cur.version,
+                    "health": h.__dict__,
+                    "nodes": nodes,
+                }
+            )
+
+        if path == "/v1/layout":
+            if request.method == "GET":
+                lay = g.layout_manager.history
+                cur = lay.current()
+                return web.json_response(
+                    {
+                        "version": cur.version,
+                        "roles": [
+                            {
+                                "id": hex_of(n),
+                                "zone": r.zone,
+                                "capacity": r.capacity,
+                                "tags": r.tags,
+                            }
+                            for n, r in cur.roles.items()
+                        ],
+                        "stagedRoleChanges": [
+                            {"id": hex_of(bytes(k)), "role": v}
+                            for k, v in lay.staging.roles.items()
+                        ],
+                    }
+                )
+            if request.method == "POST":
+                body = await request.json()
+                for change in body:
+                    nid = bytes.fromhex(change["id"])
+                    if change.get("remove"):
+                        g.layout_manager.stage_role(nid, None)
+                    else:
+                        g.layout_manager.stage_role(
+                            nid,
+                            NodeRole(
+                                zone=change["zone"],
+                                capacity=change.get("capacity"),
+                                tags=change.get("tags", []),
+                            ),
+                        )
+                return web.json_response({"staged": len(body)})
+
+        if path == "/v1/layout/apply" and request.method == "POST":
+            body = await request.json() if request.can_read_body else {}
+            lv, report = g.layout_manager.apply_staged(body.get("version"))
+            return web.json_response({"version": lv.version, "report": report})
+        if path == "/v1/layout/revert" and request.method == "POST":
+            g.layout_manager.revert_staged()
+            return web.json_response({"ok": True})
+
+        if path == "/v1/bucket":
+            if request.method == "GET":
+                if "id" in request.query:
+                    b = await g.helper.get_bucket(bytes.fromhex(request.query["id"]))
+                    p = b.params()
+                    return web.json_response(
+                        {
+                            "id": hex_of(b.id),
+                            "globalAliases": [n for n, v in p.aliases.items() if v],
+                            "websiteConfig": p.website.get(),
+                            "quotas": p.quotas.get(),
+                        }
+                    )
+                out = []
+                for b in await g.helper.list_buckets():
+                    out.append(
+                        {
+                            "id": hex_of(b.id),
+                            "globalAliases": [
+                                n for n, v in b.params().aliases.items() if v
+                            ],
+                        }
+                    )
+                return web.json_response(out)
+            if request.method == "POST":
+                body = await request.json()
+                bid = await g.helper.create_bucket(body["globalAlias"])
+                return web.json_response({"id": hex_of(bid)})
+            if request.method == "DELETE":
+                await g.helper.delete_bucket(bytes.fromhex(request.query["id"]))
+                return web.json_response({"ok": True})
+
+        if path in ("/v1/bucket/allow", "/v1/bucket/deny") and request.method == "POST":
+            body = await request.json()
+            perms = body.get("permissions", {})
+            allow = path.endswith("allow")
+            await g.helper.set_bucket_key_permissions(
+                bytes.fromhex(body["bucketId"]),
+                body["accessKeyId"],
+                allow and perms.get("read", False),
+                allow and perms.get("write", False),
+                allow and perms.get("owner", False),
+            )
+            return web.json_response({"ok": True})
+
+        if path == "/v1/key":
+            if request.method == "GET":
+                if "id" in request.query:
+                    k = await g.helper.get_key(request.query["id"])
+                    return web.json_response(
+                        {
+                            "accessKeyId": k.key_id,
+                            "name": k.params().name.get(),
+                            "secretAccessKey": k.secret()
+                            if request.query.get("showSecretKey") == "true"
+                            else None,
+                        }
+                    )
+                return web.json_response(
+                    [
+                        {"id": k.key_id, "name": k.params().name.get()}
+                        for k in await g.helper.list_keys()
+                    ]
+                )
+            if request.method == "POST":
+                body = await request.json() if request.can_read_body else {}
+                k = await g.helper.create_key(body.get("name", ""))
+                return web.json_response(
+                    {"accessKeyId": k.key_id, "secretAccessKey": k.secret()}
+                )
+            if request.method == "DELETE":
+                await g.helper.delete_key(request.query["id"])
+                return web.json_response({"ok": True})
+
+        return web.json_response({"error": "no such endpoint"}, status=404)

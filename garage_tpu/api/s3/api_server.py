@@ -136,11 +136,20 @@ class S3ApiServer:
                 return await self._list_buckets(api_key)
             raise BadRequest("no bucket specified")
 
-        if method == "PUT" and not key:
+        if (
+            method == "PUT"
+            and not key
+            and not any(s in request.query for s in ("website", "cors", "lifecycle"))
+        ):
             return await self._create_bucket(bucket_name, api_key, request, ctx)
 
         bucket_id = await self.garage.helper.resolve_bucket(bucket_name, api_key)
         perm = api_key.bucket_permissions(bucket_id)
+        q = request.query
+
+        from . import bucket_config as bc
+        from .copy_delete import handle_copy_object, handle_delete_objects
+        from . import multipart as mp
 
         if not key:
             # bucket-level ops
@@ -149,14 +158,58 @@ class S3ApiServer:
                 return web.Response(status=200)
             if method == "GET":
                 _require(perm.allow_read)
-                if request.query.get("list-type") == "2":
+                for sub, h in (
+                    ("website", bc.handle_get_website),
+                    ("cors", bc.handle_get_cors),
+                    ("lifecycle", bc.handle_get_lifecycle),
+                ):
+                    if sub in q:
+                        bucket = await self.garage.helper.get_bucket(bucket_id)
+                        return await h(self.garage, bucket, request)
+                if "uploads" in q:
+                    return await mp.handle_list_multipart_uploads(
+                        self.garage, bucket_id, bucket_name, request
+                    )
+                if "location" in q:
+                    from .xml_util import xml_doc
+
+                    return web.Response(
+                        text=xml_doc("LocationConstraint", [("", self.region)]),
+                        content_type="application/xml",
+                    )
+                if q.get("list-type") == "2":
                     return await handle_list_objects_v2(
                         self.garage, bucket_id, bucket_name, request
                     )
                 return await handle_list_objects_v1(
                     self.garage, bucket_id, bucket_name, request
                 )
+            if method == "PUT":
+                _require(perm.allow_owner)
+                for sub, h in (
+                    ("website", bc.handle_put_website),
+                    ("cors", bc.handle_put_cors),
+                    ("lifecycle", bc.handle_put_lifecycle),
+                ):
+                    if sub in q:
+                        bucket = await self.garage.helper.get_bucket(bucket_id)
+                        return await h(self.garage, bucket, request, ctx=ctx)
+                raise BadRequest("unsupported bucket PUT")
+            if method == "POST":
+                if "delete" in q:
+                    _require(perm.allow_write)
+                    return await handle_delete_objects(self.garage, bucket_id, request, ctx=ctx)
+                raise BadRequest("unsupported bucket POST")
             if method == "DELETE":
+                for sub, h in (
+                    ("website", bc.handle_delete_website),
+                    ("cors", bc.handle_delete_cors),
+                    ("lifecycle", bc.handle_delete_lifecycle),
+                ):
+                    if sub in q:
+                        _require(perm.allow_owner)
+                        bucket = await self.garage.helper.get_bucket(bucket_id)
+                        return await h(self.garage, bucket, request)
                 _require(perm.allow_owner)
                 try:
                     await self.garage.helper.delete_bucket(bucket_id)
@@ -168,15 +221,34 @@ class S3ApiServer:
             raise BadRequest(f"unsupported bucket method {method}")
 
         # object-level ops
+        if method == "POST":
+            _require(perm.allow_write)
+            if "uploads" in q:
+                return await mp.handle_create_multipart_upload(
+                    self.garage, bucket_id, key, request
+                )
+            if "uploadId" in q:
+                return await mp.handle_complete_multipart_upload(
+                    self.garage, bucket_id, key, request, ctx=ctx
+                )
+            raise BadRequest("unsupported object POST")
         if method == "PUT":
             _require(perm.allow_write)
+            if "partNumber" in q:
+                return await mp.handle_upload_part(
+                    self.garage, bucket_id, key, request, ctx=ctx
+                )
             if "x-amz-copy-source" in request.headers:
-                raise NotImplementedError_("CopyObject lands in M6")
+                return await handle_copy_object(
+                    self.garage, self.garage.helper, api_key, bucket_id, key, request
+                )
             return await handle_put_object(
                 self.garage, bucket_id, key, request, ctx=ctx
             )
         if method == "GET":
             _require(perm.allow_read)
+            if "uploadId" in q:
+                return await mp.handle_list_parts(self.garage, bucket_id, key, request)
             return await handle_get_object(self.garage, bucket_id, key, request)
         if method == "HEAD":
             _require(perm.allow_read)
@@ -185,6 +257,10 @@ class S3ApiServer:
             )
         if method == "DELETE":
             _require(perm.allow_write)
+            if "uploadId" in q:
+                return await mp.handle_abort_multipart_upload(
+                    self.garage, bucket_id, key, request
+                )
             return await handle_delete_object(self.garage, bucket_id, key)
         raise BadRequest(f"unsupported method {method}")
 
@@ -206,7 +282,7 @@ class S3ApiServer:
                 for name, v in b.params().aliases.items():
                     if v:
                         buckets.append((name, b.params().creation_date))
-        from .list import _http_iso
+        from .xml_util import http_iso as _http_iso
 
         children = [
             ("Owner", [("ID", api_key.key_id), ("DisplayName", api_key.key_id)]),

@@ -30,6 +30,17 @@ class S3Client:
         self.region = region
         host = urllib.parse.urlparse(self.endpoint).netloc
         self.host = host
+        self._session: aiohttp.ClientSession | None = None
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
 
     async def _req(
         self,
@@ -47,12 +58,11 @@ class S3Client:
         )
         qs = urllib.parse.urlencode(query)
         url = self.endpoint + urllib.parse.quote(path) + ("?" + qs if qs else "")
-        async with aiohttp.ClientSession() as sess:
-            async with sess.request(
-                method, url, data=body, headers=signed, skip_auto_headers=["Content-Type"]
-            ) as resp:
-                data = await resp.read()
-                return resp.status, resp.headers.copy(), data  # case-insensitive
+        async with self._sess().request(
+            method, url, data=body, headers=signed, skip_auto_headers=["Content-Type"]
+        ) as resp:
+            data = await resp.read()
+            return resp.status, resp.headers.copy(), data  # case-insensitive
 
     def _check(self, status: int, data: bytes, ok=(200, 204, 206)):
         if status not in ok:
@@ -142,3 +152,95 @@ class S3Client:
             "truncated": root.findtext("s3:IsTruncated", namespaces=ns) == "true",
             "next_token": root.findtext("s3:NextContinuationToken", namespaces=ns),
         }
+
+    # --- multipart ------------------------------------------------------------
+
+    async def create_multipart_upload(self, bucket: str, key: str) -> str:
+        st, _h, data = await self._req("POST", f"/{bucket}/{key}", query=[("uploads", "")])
+        self._check(st, data)
+        root = ET.fromstring(data.decode())
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        return root.findtext("s3:UploadId", namespaces=ns)
+
+    async def upload_part(
+        self, bucket: str, key: str, upload_id: str, part_number: int, body: bytes
+    ) -> str:
+        st, h, data = await self._req(
+            "PUT",
+            f"/{bucket}/{key}",
+            query=[("partNumber", str(part_number)), ("uploadId", upload_id)],
+            body=body,
+        )
+        self._check(st, data)
+        return h.get("ETag", "").strip('"')
+
+    async def complete_multipart_upload(
+        self, bucket: str, key: str, upload_id: str, parts: list[tuple[int, str]]
+    ) -> str:
+        body = (
+            '<CompleteMultipartUpload>'
+            + "".join(
+                f"<Part><PartNumber>{pn}</PartNumber><ETag>\"{etag}\"</ETag></Part>"
+                for pn, etag in parts
+            )
+            + "</CompleteMultipartUpload>"
+        ).encode()
+        st, _h, data = await self._req(
+            "POST", f"/{bucket}/{key}", query=[("uploadId", upload_id)], body=body
+        )
+        self._check(st, data)
+        root = ET.fromstring(data.decode())
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        return (root.findtext("s3:ETag", namespaces=ns) or "").strip('"')
+
+    async def abort_multipart_upload(self, bucket: str, key: str, upload_id: str):
+        st, _h, data = await self._req(
+            "DELETE", f"/{bucket}/{key}", query=[("uploadId", upload_id)]
+        )
+        self._check(st, data)
+
+    async def list_parts(self, bucket: str, key: str, upload_id: str) -> list[dict]:
+        st, _h, data = await self._req(
+            "GET", f"/{bucket}/{key}", query=[("uploadId", upload_id)]
+        )
+        self._check(st, data)
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        root = ET.fromstring(data.decode())
+        return [
+            {
+                "part": int(p.findtext("s3:PartNumber", namespaces=ns)),
+                "etag": (p.findtext("s3:ETag", namespaces=ns) or "").strip('"'),
+                "size": int(p.findtext("s3:Size", namespaces=ns) or 0),
+            }
+            for p in root.findall("s3:Part", ns)
+        ]
+
+    async def copy_object(self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str):
+        st, _h, data = await self._req(
+            "PUT",
+            f"/{dst_bucket}/{dst_key}",
+            headers={"x-amz-copy-source": f"/{src_bucket}/{src_key}"},
+        )
+        self._check(st, data)
+
+    async def delete_objects(self, bucket: str, keys: list[str]) -> None:
+        body = (
+            "<Delete>"
+            + "".join(f"<Object><Key>{k}</Key></Object>" for k in keys)
+            + "</Delete>"
+        ).encode()
+        st, _h, data = await self._req(
+            "POST", f"/{bucket}", query=[("delete", "")], body=body
+        )
+        self._check(st, data)
+
+    async def put_bucket_config(self, bucket: str, sub: str, xml_body: bytes):
+        st, _h, data = await self._req(
+            "PUT", f"/{bucket}", query=[(sub, "")], body=xml_body
+        )
+        self._check(st, data)
+
+    async def get_bucket_config(self, bucket: str, sub: str) -> bytes:
+        st, _h, data = await self._req("GET", f"/{bucket}", query=[(sub, "")])
+        self._check(st, data)
+        return data

@@ -7,7 +7,7 @@ import base64
 
 from aiohttp import web
 
-from .xml_util import xml_doc
+from .xml_util import http_iso as _http_iso, xml_doc
 
 
 async def _collect(
@@ -24,9 +24,11 @@ async def _collect(
     strictly after it, so no key is dropped at page boundaries."""
     entries = []
     prefixes: set[str] = set()
-    # seek straight to the interesting range
+    # seek straight to the interesting range; only start_after is an
+    # EXCLUSIVE bound — a key exactly equal to the prefix must be listed
     cursor = max(start_after, prefix).encode() if prefix else start_after.encode()
-    last = cursor.decode(errors="surrogateescape")
+    floor = start_after  # strictly-greater-than bound
+    last = floor or cursor.decode(errors="surrogateescape")
     while True:
         batch = await garage.object_table.get_range(
             bucket_id, cursor, "visible", 1000
@@ -35,7 +37,7 @@ async def _collect(
             break
         for obj in batch:
             k = obj.key
-            if cursor != b"" and k.encode() <= cursor:
+            if floor and k <= floor:
                 continue
             if prefix:
                 if not k.startswith(prefix):
@@ -66,17 +68,10 @@ async def _collect(
             )
             last = k
         cursor = batch[-1].key.encode()
+        floor = batch[-1].key  # next batch starts strictly after
         if len(batch) < 1000:
             break
     return entries, sorted(prefixes), False, ""
-
-
-def _http_iso(ts_ms: int) -> str:
-    from datetime import datetime, timezone
-
-    return datetime.fromtimestamp(ts_ms / 1000, tz=timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%S.000Z"
-    )
 
 
 async def handle_list_objects_v2(garage, bucket_id: bytes, bucket_name: str, request):

@@ -1,0 +1,234 @@
+"""Multipart uploads (reference src/api/s3/multipart.rs).
+
+Create/UploadPart/Complete/Abort/ListParts/ListMultipartUploads.  Each
+part gets its own Version entry whose blocks are written with the normal
+bounded pipeline; Complete assembles a final Version referencing every
+kept part's blocks as [part_number, offset] keys, inserts fresh block
+refs for it, then tombstones the part versions (stale re-uploads
+included) — refcounts make the handoff safe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import xml.etree.ElementTree as ET
+
+from aiohttp import web
+
+from ...model.s3.block_ref_table import BlockRef
+from ...model.s3.mpu_table import MultipartUpload
+from ...model.s3.object_table import Object, ObjectVersion
+from ...model.s3.version_table import Version
+from ...utils.data import blake2sum, gen_uuid
+from ...utils.time_util import now_msec
+from ..common.error import ApiError, BadRequest, NoSuchKey, NoSuchUpload
+from .objects import PUT_BLOCKS_MAX_PARALLEL, SAVED_HEADERS, _check_sha256
+from .xml_util import xml_doc
+
+
+async def handle_create_multipart_upload(garage, bucket_id, key, request):
+    upload_id = gen_uuid()
+    headers = [
+        [h.lower(), v]
+        for h, v in request.headers.items()
+        if h.lower() in SAVED_HEADERS
+    ]
+    mpu = MultipartUpload(upload_id, bucket_id, key, timestamp=now_msec())
+    await garage.mpu_table.insert(mpu)
+    # an uploading object version marks the in-flight upload in listings
+    ov = ObjectVersion(
+        upload_id, mpu.timestamp, "uploading",
+        {"t": "first_block", "vid": upload_id, "mpu": True, "hdrs": headers},
+    )
+    await garage.object_table.insert(Object(bucket_id, key, [ov]))
+    return web.Response(
+        text=xml_doc(
+            "InitiateMultipartUploadResult",
+            [("Bucket", ""), ("Key", key), ("UploadId", upload_id.hex())],
+        ),
+        content_type="application/xml",
+    )
+
+
+async def _get_mpu(garage, bucket_id, key, upload_id_hex) -> MultipartUpload:
+    try:
+        upload_id = bytes.fromhex(upload_id_hex)
+        assert len(upload_id) == 32
+    except (ValueError, AssertionError) as e:
+        raise NoSuchUpload(f"malformed upload id") from e
+    mpu = await garage.mpu_table.get(upload_id, b"")
+    if mpu is None or mpu.deleted.get() or mpu.bucket_id != bucket_id or mpu.key != key:
+        raise NoSuchUpload("upload not found")
+    return mpu
+
+
+async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
+    q = request.query
+    part_number = int(q.get("partNumber", "0"))
+    if not (1 <= part_number <= 10000):
+        raise BadRequest("partNumber must be in 1..10000")
+    mpu = await _get_mpu(garage, bucket_id, key, q.get("uploadId", ""))
+
+    vid = gen_uuid()  # this part's own version
+    await garage.version_table.insert(Version(vid, bucket_id, key))
+    from .objects import stream_blocks
+
+    try:
+        md5_hex, sha, total = await stream_blocks(
+            garage, vid, bucket_id, key, part_number,
+            request.content, garage.config.block_size,
+        )
+        _check_sha256(ctx, sha)
+    except BaseException:
+        await garage.version_table.insert(
+            Version.deleted_marker(vid, bucket_id, key)
+        )
+        raise
+
+    etag = md5_hex
+    upd = MultipartUpload(mpu.upload_id, bucket_id, key, timestamp=mpu.timestamp)
+    upd.parts.put([part_number, now_msec()], {"vid": vid, "etag": etag, "s": total})
+    await garage.mpu_table.insert(upd)
+    return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+
+async def handle_complete_multipart_upload(garage, bucket_id, key, request, ctx=None):
+    body = await request.read()
+    from ..common.signature import check_payload
+
+    await check_payload(body, ctx) if ctx else None
+    mpu = await _get_mpu(garage, bucket_id, key, request.query.get("uploadId", ""))
+    try:
+        root = ET.fromstring(body.decode())
+        req_parts = []
+        for p in root.iter():
+            if p.tag.endswith("Part"):
+                pn = etag = None
+                for c in p:
+                    if c.tag.endswith("PartNumber"):
+                        pn = int(c.text)
+                    elif c.tag.endswith("ETag"):
+                        etag = c.text.strip().strip('"')
+                req_parts.append((pn, etag))
+    except ET.ParseError as e:
+        raise BadRequest(f"malformed CompleteMultipartUpload XML: {e}") from e
+    if not req_parts:
+        raise BadRequest("no parts in CompleteMultipartUpload")
+    if [p for p, _ in req_parts] != sorted(p for p, _ in req_parts):
+        raise BadRequest("parts must be listed in ascending order", code="InvalidPartOrder")
+
+    have = mpu.latest_parts()
+    for pn, etag in req_parts:
+        if pn not in have or have[pn]["etag"] != etag:
+            raise ApiError("part missing or etag mismatch", code="InvalidPart", status=400)
+
+    # assemble the final version from the kept parts' blocks
+    final = Version(mpu.upload_id, bucket_id, key)
+    total = 0
+    etags_md5 = hashlib.md5()
+    kept_vids = []
+    for pn, _etag in req_parts:
+        part = have[pn]
+        kept_vids.append(bytes(part["vid"]))
+        etags_md5.update(bytes.fromhex(part["etag"]))
+        pv = await garage.version_table.get(bytes(part["vid"]), b"")
+        if pv is None or pv.deleted.get():
+            raise ApiError("part data lost", code="InvalidPart", status=400)
+        for (p_pn, off), blk in pv.sorted_blocks():
+            final.blocks.put([pn, off], {"h": blk["h"], "s": blk["s"]})
+            total += blk["s"]
+    await garage.version_table.insert(final)
+    # fresh refs for the final version BEFORE tombstoning part versions
+    for _k, blk in final.sorted_blocks():
+        await garage.block_ref_table.insert(BlockRef(blk["h"], final.uuid))
+    etag = f"{etags_md5.hexdigest()}-{len(req_parts)}"
+    ov = ObjectVersion(
+        mpu.upload_id,
+        mpu.timestamp,
+        "complete",
+        {
+            "t": "first_block",
+            "vid": final.uuid,
+            "meta": {"size": total, "etag": etag, "headers": []},
+        },
+    )
+    await garage.object_table.insert(Object(bucket_id, key, [ov]))
+    # tombstone part versions (incl. stale re-uploads) and close the mpu
+    for k, v in mpu.parts.items():
+        if bytes(v["vid"]) != final.uuid:
+            await garage.version_table.insert(
+                Version.deleted_marker(bytes(v["vid"]), bucket_id, key)
+            )
+    closed = MultipartUpload(mpu.upload_id, bucket_id, key, timestamp=mpu.timestamp)
+    closed.deleted.set()
+    await garage.mpu_table.insert(closed)
+    return web.Response(
+        text=xml_doc(
+            "CompleteMultipartUploadResult",
+            [("Bucket", ""), ("Key", key), ("ETag", f'"{etag}"')],
+        ),
+        content_type="application/xml",
+    )
+
+
+async def handle_abort_multipart_upload(garage, bucket_id, key, request):
+    mpu = await _get_mpu(garage, bucket_id, key, request.query.get("uploadId", ""))
+    closed = MultipartUpload(mpu.upload_id, bucket_id, key, timestamp=mpu.timestamp)
+    closed.deleted.set()
+    await garage.mpu_table.insert(closed)  # cascade deletes part versions
+    aborted = ObjectVersion(
+        mpu.upload_id, mpu.timestamp, "aborted", {"t": "first_block", "vid": mpu.upload_id}
+    )
+    await garage.object_table.insert(Object(bucket_id, key, [aborted]))
+    return web.Response(status=204)
+
+
+async def handle_list_parts(garage, bucket_id, key, request):
+    mpu = await _get_mpu(garage, bucket_id, key, request.query.get("uploadId", ""))
+    parts = mpu.latest_parts()
+    children = [
+        ("Bucket", ""),
+        ("Key", key),
+        ("UploadId", mpu.upload_id.hex()),
+        ("StorageClass", "STANDARD"),
+        ("IsTruncated", False),
+    ]
+    for pn in sorted(parts):
+        p = parts[pn]
+        children.append(
+            (
+                "Part",
+                [
+                    ("PartNumber", pn),
+                    ("ETag", f'"{p["etag"]}"'),
+                    ("Size", p["s"]),
+                ],
+            )
+        )
+    return web.Response(
+        text=xml_doc("ListPartsResult", children), content_type="application/xml"
+    )
+
+
+async def handle_list_multipart_uploads(garage, bucket_id, bucket_name, request):
+    # in-flight uploads = objects with an uploading mpu version
+    objs = await garage.object_table.get_range(bucket_id, None, None, 1000)
+    children = [("Bucket", bucket_name), ("IsTruncated", False)]
+    for o in objs:
+        for v in o.versions:
+            if v.state == "uploading" and v.data.get("mpu"):
+                children.append(
+                    (
+                        "Upload",
+                        [
+                            ("Key", o.key),
+                            ("UploadId", v.uuid.hex()),
+                            ("StorageClass", "STANDARD"),
+                        ],
+                    )
+                )
+    return web.Response(
+        text=xml_doc("ListMultipartUploadsResult", children),
+        content_type="application/xml",
+    )

@@ -71,6 +71,93 @@ def _check_sha256(ctx, digest: "hashlib._Hash") -> None:
             )
 
 
+async def check_quotas(garage, bucket_id: bytes, key: str, new_size: int) -> None:
+    """Enforce bucket quotas against the distributed counters, crediting
+    the object being overwritten (reference put.rs:315 check_quotas)."""
+    bucket = await garage.helper.get_bucket(bucket_id)
+    q = bucket.params().quotas.get() or {}
+    if not q.get("max_size") and not q.get("max_objects"):
+        return
+    counts = await garage.object_counter.get_values(bucket_id)
+    prev_objects = prev_bytes = 0
+    existing = await garage.object_table.get(bucket_id, key.encode())
+    if existing is not None:
+        vis = existing.last_visible()
+        if vis is not None:
+            prev_objects = 1
+            prev_bytes = vis.data.get("meta", {}).get("size", 0)
+    if q.get("max_objects") is not None:
+        if counts.get("objects", 0) - prev_objects + 1 > q["max_objects"]:
+            raise ApiError("object count quota exceeded", code="QuotaExceeded", status=403)
+    if q.get("max_size") is not None:
+        if counts.get("bytes", 0) - prev_bytes + new_size > q["max_size"]:
+            raise ApiError("size quota exceeded", code="QuotaExceeded", status=403)
+
+
+
+async def stream_blocks(
+    garage, vid: bytes, bucket_id: bytes, key: str, part_number: int,
+    body, block_size: int, first: bytes = b"",
+):
+    """THE block-write pipeline shared by PutObject and UploadPart:
+    chunk the body, store blocks with bounded parallelism
+    (PUT_BLOCKS_MAX_PARALLEL), record version block entries + block refs
+    as we go.  Returns (md5_hex, sha_obj, total_bytes); on failure the
+    caller is responsible for tombstoning `vid`."""
+    md5 = hashlib.md5()
+    sha = hashlib.sha256()
+    total = 0
+    offset = 0
+    inflight: set[asyncio.Task] = set()
+
+    async def put_one(block: bytes, block_offset: int):
+        h = blake2sum(block)
+        await garage.block_manager.rpc_put_block(h, block)
+        v = Version(vid, bucket_id, key)
+        v.blocks.put([part_number, block_offset], {"h": h, "s": len(block)})
+        await garage.version_table.insert(v)
+        await garage.block_ref_table.insert(BlockRef(h, vid))
+
+    async def launch(block: bytes, block_offset: int):
+        # backpressure: at most PUT_BLOCKS_MAX_PARALLEL blocks buffered in
+        # flight — the read loop (and the client) stall while storage
+        # catches up (reference put.rs:42)
+        while len(inflight) >= PUT_BLOCKS_MAX_PARALLEL:
+            done, _ = await asyncio.wait(inflight, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                inflight.discard(t)
+                if t.exception():
+                    raise t.exception()
+        inflight.add(asyncio.create_task(put_one(block, block_offset)))
+
+    try:
+        buf = first
+        while True:
+            while len(buf) >= block_size:
+                block, buf = buf[:block_size], buf[block_size:]
+                md5.update(block)
+                sha.update(block)
+                await launch(block, offset)
+                offset += len(block)
+                total += len(block)
+            chunk = await body.read(block_size)
+            if not chunk:
+                break
+            buf += chunk
+        if buf:
+            md5.update(buf)
+            sha.update(buf)
+            await launch(buf, offset)
+            total += len(buf)
+        if inflight:
+            await asyncio.gather(*inflight)
+    except BaseException:
+        for t in inflight:
+            t.cancel()
+        raise
+    return md5.hexdigest(), sha, total
+
+
 async def handle_put_object(
     garage, bucket_id: bytes, key: str, request, ctx=None
 ) -> web.Response:
@@ -88,6 +175,7 @@ async def handle_put_object(
         # inline object
         sha = hashlib.sha256(first)
         _check_sha256(ctx, sha)
+        await check_quotas(garage, bucket_id, key, len(first))
         etag = hashlib.md5(first).hexdigest()
         version = ObjectVersion(
             gen_uuid(),
@@ -108,59 +196,16 @@ async def handle_put_object(
     version0 = ObjectVersion(vid, ts, "uploading", {"t": "first_block", "vid": vid})
     await garage.object_table.insert(Object(bucket_id, key, [version0]))
     await garage.version_table.insert(Version(vid, bucket_id, key))
+    buf_first = first
 
-    md5 = hashlib.md5()
-    sha = hashlib.sha256()
-    total = 0
-    offset = 0
-    inflight: set[asyncio.Task] = set()
     try:
-        buf = first
-
-        async def put_one(block: bytes, block_offset: int):
-            h = blake2sum(block)
-            await garage.block_manager.rpc_put_block(h, block)
-            v = Version(vid, bucket_id, key)
-            v.blocks.put([0, block_offset], {"h": h, "s": len(block)})
-            await garage.version_table.insert(v)
-            await garage.block_ref_table.insert(BlockRef(h, vid))
-
-        async def launch(block: bytes, block_offset: int):
-            # backpressure: at most PUT_BLOCKS_MAX_PARALLEL blocks buffered
-            # in flight — the read loop stalls (and so does the client)
-            # while storage catches up (reference put.rs:42)
-            while len(inflight) >= PUT_BLOCKS_MAX_PARALLEL:
-                done, _ = await asyncio.wait(
-                    inflight, return_when=asyncio.FIRST_COMPLETED
-                )
-                for t in done:
-                    inflight.discard(t)
-                    if t.exception():
-                        raise t.exception()
-            inflight.add(asyncio.create_task(put_one(block, block_offset)))
-
-        while True:
-            while len(buf) >= block_size:
-                block, buf = buf[:block_size], buf[block_size:]
-                md5.update(block)
-                sha.update(block)
-                await launch(block, offset)
-                offset += len(block)
-                total += len(block)
-            chunk = await body.read(block_size)
-            if not chunk:
-                break
-            buf += chunk
-        if buf:
-            md5.update(buf)
-            sha.update(buf)
-            await launch(buf, offset)
-            total += len(buf)
-        if inflight:
-            await asyncio.gather(*inflight)
+        md5_hex, sha, total = await stream_blocks(
+            garage, vid, bucket_id, key, 0, body, block_size, first=buf_first
+        )
         _check_sha256(ctx, sha)
+        await check_quotas(garage, bucket_id, key, total)
 
-        etag = md5.hexdigest()
+        etag = md5_hex
         final = ObjectVersion(
             vid,
             ts,
@@ -176,8 +221,6 @@ async def handle_put_object(
     except BaseException:
         # InterruptedCleanup (reference put.rs:217-223): mark aborted so
         # the cascade reclaims stored blocks
-        for t in inflight:
-            t.cancel()
         aborted = ObjectVersion(vid, ts, "aborted", {"t": "first_block", "vid": vid})
         try:
             await garage.object_table.insert(Object(bucket_id, key, [aborted]))

@@ -29,3 +29,12 @@ def _render(children) -> str:
         else:
             out.append(f"<{name}>{escape(str(value))}</{name}>")
     return "".join(out)
+
+
+def http_iso(ts_ms: int) -> str:
+    """ISO-8601 object timestamp used across listings/copy results."""
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(ts_ms / 1000, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z"
+    )

@@ -223,6 +223,11 @@ class AdminRpcHandler:
             raise ValueError(f"unknown repair target {what!r}")
         return f"repair {what} launched"
 
+    async def op_meta_snapshot(self, args) -> Any:
+        from ..model.snapshot import take_snapshot
+
+        return {"snapshot": take_snapshot(self.garage)}
+
     async def op_stats(self, args) -> Any:
         g = self.garage
         return {

@@ -26,7 +26,7 @@ import signal
 import sys
 
 from ..format_table import format_table
-from ..model.garage import Garage, _parse_addr
+from ..model.garage import Garage, _parse_addr, network_key_from_secret
 from ..net.handshake import gen_node_key
 from ..net.netapp import NetApp
 from ..utils.config import read_config
@@ -95,6 +95,8 @@ def main(argv=None):
     wrk.add_argument("worker_cmd", choices=["list"])
     rep = sub.add_parser("repair")
     rep.add_argument("what", choices=["blocks", "rebalance", "tables"])
+    meta = sub.add_parser("meta")
+    meta.add_argument("meta_cmd", choices=["snapshot"])
 
     args = ap.parse_args(argv)
 
@@ -119,11 +121,26 @@ async def run_server(config_path: str) -> None:
     AdminRpcHandler(garage)
     garage.spawn_workers()
 
-    s3 = None
+    servers = []
     if config.s3_api.api_bind_addr:
         s3 = S3ApiServer(garage)
         host, port = _parse_addr(config.s3_api.api_bind_addr)
         await s3.start(host, port)
+        servers.append(s3)
+    if config.s3_web.bind_addr:
+        from ..web.web_server import WebServer
+
+        webs = WebServer(garage)
+        host, port = _parse_addr(config.s3_web.bind_addr)
+        await webs.start(host, port)
+        servers.append(webs)
+    if config.admin.api_bind_addr:
+        from ..api.admin.api_server import AdminApiServer
+
+        adm = AdminApiServer(garage)
+        host, port = _parse_addr(config.admin.api_bind_addr)
+        await adm.start(host, port)
+        servers.append(adm)
 
     print(f"garage-tpu node {garage.node_id.hex()} up", flush=True)
     stop = asyncio.Event()
@@ -132,8 +149,8 @@ async def run_server(config_path: str) -> None:
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
     print("shutting down...", flush=True)
-    if s3:
-        await s3.stop()
+    for s in servers:
+        await s.stop()
     await garage.stop()
 
 
@@ -150,7 +167,7 @@ async def run_cli(args) -> None:
         return
 
     # connect to the daemon as an ephemeral peer
-    network_key = bytes.fromhex(config.rpc_secret.ljust(64, "0"))[:32]
+    network_key = network_key_from_secret(config.rpc_secret)
     app = NetApp(network_key, gen_node_key())
     addr = _parse_addr(config.rpc_public_addr or config.rpc_bind_addr)
     if addr[0] == "0.0.0.0":
@@ -310,6 +327,9 @@ async def dispatch(args, call, config) -> str | None:
 
     if args.cmd == "repair":
         return str(await call("repair", {"what": args.what}))
+
+    if args.cmd == "meta" and args.meta_cmd == "snapshot":
+        return json.dumps(await call("meta-snapshot"))
 
     return None
 

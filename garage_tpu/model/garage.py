@@ -35,6 +35,11 @@ from .s3.version_table import VersionTable
 logger = logging.getLogger("garage")
 
 
+def network_key_from_secret(secret: str) -> bytes:
+    """rpc_secret (hex) -> the 32-byte cluster network key."""
+    return bytes.fromhex(secret.ljust(64, "0"))[:32]
+
+
 def _parse_addr(s: str) -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     return (host.strip("[]") or "0.0.0.0", int(port))
@@ -69,7 +74,7 @@ class Garage:
 
         if not config.rpc_secret:
             raise ValueError("rpc_secret is required")
-        network_key = bytes.fromhex(config.rpc_secret.ljust(64, "0"))[:32]
+        network_key = network_key_from_secret(config.rpc_secret)
 
         self.db = open_db(
             os.path.join(meta, "db"),
@@ -136,6 +141,21 @@ class Garage:
         self.object_table = Table(
             self.system, self.helper_rpc, self.db, self.object_schema, sharded
         )
+        from .s3.mpu_table import MpuTable
+
+        self.mpu_table = Table(
+            self.system, self.helper_rpc, self.db, MpuTable(self.version_table), sharded
+        )
+        from .index_counter import CounterTable, IndexCounter
+
+        self.object_counter_table = Table(
+            self.system, self.helper_rpc, self.db,
+            CounterTable("bucket_object_counter"), sharded,
+        )
+        self.object_counter = IndexCounter(
+            self.system, self.object_counter_table, self.db
+        )
+        self.object_schema.counter = self.object_counter
         self.bucket_table = Table(
             self.system, self.helper_rpc, self.db, BucketTable(), fullcopy
         )
@@ -146,9 +166,11 @@ class Garage:
             self.system, self.helper_rpc, self.db, KeyTable(), fullcopy
         )
         self.tables = [
+            self.object_counter_table,
             self.object_table,
             self.version_table,
             self.block_ref_table,
+            self.mpu_table,
             self.bucket_table,
             self.bucket_alias_table,
             self.key_table,
@@ -172,6 +194,12 @@ class Garage:
         for t in self.tables:
             t.spawn_workers(self.bg)
         self.block_manager.spawn_workers(self.bg)
+        from .s3.lifecycle_worker import LifecycleWorker
+        from .snapshot import SnapshotWorker
+
+        self.bg.spawn(LifecycleWorker(self, metadata_dir=self.config.metadata_dir))
+        if self.config.metadata_auto_snapshot_interval:
+            self.bg.spawn(SnapshotWorker(self))
 
     async def stop(self) -> None:
         await self.bg.shutdown()

@@ -110,11 +110,24 @@ class Object:
         return [self.bucket_id, self.key, [v.to_obj() for v in self.versions]]
 
 
+def object_counts(e: "Object | None") -> dict[str, int]:
+    """Counter deltas source (reference object_table.rs counts())."""
+    if e is None:
+        return {"objects": 0, "bytes": 0, "unfinished_uploads": 0}
+    vis = e.last_visible()
+    return {
+        "objects": 1 if vis is not None else 0,
+        "bytes": vis.data.get("meta", {}).get("size", 0) if vis else 0,
+        "unfinished_uploads": sum(1 for v in e.versions if v.state == "uploading"),
+    }
+
+
 class ObjectTable(TableSchema):
     table_name = "object"
 
-    def __init__(self, version_table=None):
+    def __init__(self, version_table=None, counter=None):
         self.version_table = version_table  # set by Garage after wiring
+        self.counter = counter  # IndexCounter for per-bucket usage
 
     def entry_partition_key(self, e: Object) -> bytes:
         return e.bucket_id
@@ -143,6 +156,12 @@ class ObjectTable(TableSchema):
     def updated(self, tx, old: Object | None, new: Object | None) -> None:
         """Cascade: versions that disappeared (pruned/aborted) get their
         data deleted via the version table (reference updated() hook)."""
+        if self.counter is not None:
+            oldc = object_counts(old)
+            newc = object_counts(new)
+            deltas = {k: newc[k] - oldc[k] for k in newc}
+            pk = (new or old).bucket_id
+            self.counter.count(tx, pk, b"", deltas)
         if self.version_table is None:
             return
         from .version_table import Version

@@ -1,0 +1,3 @@
+from .web_server import WebServer
+
+__all__ = ["WebServer"]

@@ -261,3 +261,229 @@ def test_put_payload_hash_enforced(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_multipart_upload(tmp_path):
+    async def main():
+        import hashlib
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("mpu")
+            parts_data = [os.urandom(10_000), os.urandom(12_345), os.urandom(7_000)]
+            uid = await client.create_multipart_upload("mpu", "assembled.bin")
+            assert uid
+            # upload parts out of order, re-upload part 2
+            etags = {}
+            etags[2] = await client.upload_part("mpu", "assembled.bin", uid, 2, b"garbage")
+            etags[1] = await client.upload_part("mpu", "assembled.bin", uid, 1, parts_data[0])
+            etags[3] = await client.upload_part("mpu", "assembled.bin", uid, 3, parts_data[2])
+            etags[2] = await client.upload_part("mpu", "assembled.bin", uid, 2, parts_data[1])
+            listed = await client.list_parts("mpu", "assembled.bin", uid)
+            assert [p["part"] for p in listed] == [1, 2, 3]
+            assert listed[1]["size"] == 12_345
+            final_etag = await client.complete_multipart_upload(
+                "mpu", "assembled.bin", uid, [(i, etags[i]) for i in (1, 2, 3)]
+            )
+            whole = b"".join(parts_data)
+            got = await client.get_object("mpu", "assembled.bin")
+            assert got == whole
+            md5s = b"".join(hashlib.md5(p).digest() for p in parts_data)
+            assert final_etag == hashlib.md5(md5s).hexdigest() + "-3"
+            # range across part boundary
+            r = await client.get_object("mpu", "assembled.bin", range_="bytes=9000-15000")
+            assert r == whole[9000:15001]
+            # completed upload is gone
+            with pytest.raises(S3Error):
+                await client.list_parts("mpu", "assembled.bin", uid)
+            # stale part-2 blocks get dereferenced eventually
+            bm = garage.block_manager
+            await asyncio.sleep(0.5)
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_multipart_abort_frees_blocks(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("mpa")
+            uid = await client.create_multipart_upload("mpa", "gone.bin")
+            await client.upload_part("mpa", "gone.bin", uid, 1, os.urandom(9_000))
+            bm = garage.block_manager
+            needed = [h for h, _v in bm.rc.tree.iter_range() if bm.rc.is_needed(h)]
+            assert needed
+            await client.abort_multipart_upload("mpa", "gone.bin", uid)
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if not any(bm.rc.is_needed(h) for h in needed):
+                    break
+            assert not any(bm.rc.is_needed(h) for h in needed)
+            # object does not exist
+            with pytest.raises(S3Error):
+                await client.get_object("mpa", "gone.bin")
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_copy_and_batch_delete(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("cpy")
+            big = os.urandom(15_000)
+            await client.put_object("cpy", "orig", big)
+            await client.copy_object("cpy", "orig", "cpy", "copy")
+            assert await client.get_object("cpy", "copy") == big
+            # copy shares blocks: refcounts should be 2 for shared blocks
+            bm = garage.block_manager
+            counts = [bm.rc.get(h) for h, _v in bm.rc.tree.iter_range()]
+            assert 2 in counts
+            # deleting the original keeps the copy readable
+            await client.delete_object("cpy", "orig")
+            assert await client.get_object("cpy", "copy") == big
+            # batch delete
+            await client.put_object("cpy", "a", b"1")
+            await client.put_object("cpy", "b", b"2")
+            await client.delete_objects("cpy", ["a", "b", "copy"])
+            ls = await client.list_objects_v2("cpy")
+            assert ls["keys"] == []
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_bucket_config_and_website(tmp_path):
+    async def main():
+        import aiohttp
+
+        from garage_tpu.web.web_server import WebServer
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        web_srv = WebServer(garage)
+        garage.config.s3_web.root_domain = "web.garage"
+        web_srv.root_domain = "web.garage"
+        await web_srv.start("127.0.0.1", 0)
+        web_port = web_srv.runner.addresses[0][1]
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("site")
+            await client.put_object("site", "index.html", b"<h1>home</h1>")
+            await client.put_object("site", "err.html", b"<h1>oops</h1>")
+            # no website config yet
+            wcfg = (
+                b'<WebsiteConfiguration>'
+                b"<IndexDocument><Suffix>index.html</Suffix></IndexDocument>"
+                b"<ErrorDocument><Key>err.html</Key></ErrorDocument>"
+                b"</WebsiteConfiguration>"
+            )
+            await client.put_bucket_config("site", "website", wcfg)
+            got = await client.get_bucket_config("site", "website")
+            assert b"index.html" in got
+            # serve through the web server, vhost style
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{web_port}/",
+                    headers={"Host": "site.web.garage"},
+                ) as resp:
+                    assert resp.status == 200
+                    assert await resp.read() == b"<h1>home</h1>"
+                async with sess.get(
+                    f"http://127.0.0.1:{web_port}/nope.html",
+                    headers={"Host": "site.web.garage"},
+                ) as resp:
+                    assert resp.status == 404
+                    assert await resp.read() == b"<h1>oops</h1>"
+            # CORS config roundtrip
+            ccfg = (
+                b"<CORSConfiguration><CORSRule>"
+                b"<AllowedOrigin>*</AllowedOrigin><AllowedMethod>GET</AllowedMethod>"
+                b"</CORSRule></CORSConfiguration>"
+            )
+            await client.put_bucket_config("site", "cors", ccfg)
+            assert b"AllowedOrigin" in await client.get_bucket_config("site", "cors")
+            # lifecycle config roundtrip
+            lcfg = (
+                b"<LifecycleConfiguration><Rule><ID>r1</ID><Status>Enabled</Status>"
+                b"<Filter><Prefix>tmp/</Prefix></Filter>"
+                b"<Expiration><Days>30</Days></Expiration>"
+                b"</Rule></LifecycleConfiguration>"
+            )
+            await client.put_bucket_config("site", "lifecycle", lcfg)
+            assert b"tmp/" in await client.get_bucket_config("site", "lifecycle")
+        finally:
+            await web_srv.stop()
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_admin_api(tmp_path):
+    async def main():
+        import aiohttp
+
+        from garage_tpu.api.admin.api_server import AdminApiServer
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        garage.config.admin.admin_token = "sekrit-admin"
+        adm = AdminApiServer(garage)
+        await adm.start("127.0.0.1", 0)
+        port = adm.runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as sess:
+                # health needs no auth
+                async with sess.get(base + "/health") as r:
+                    assert r.status == 200
+                    h = await r.json()
+                    assert h["status"] in ("healthy", "degraded")
+                # metrics guarded... no metrics_token set -> open
+                async with sess.get(base + "/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
+                    assert "cluster_healthy" in text
+                    assert 'table_size{table_name="object"}' in text
+                # v1 requires the admin token
+                async with sess.get(base + "/v1/status") as r:
+                    assert r.status == 403
+                hdr = {"Authorization": "Bearer sekrit-admin"}
+                async with sess.get(base + "/v1/status", headers=hdr) as r:
+                    assert r.status == 200
+                    st = await r.json()
+                    assert st["layoutVersion"] == 1
+                # create a key + bucket via admin api
+                async with sess.post(base + "/v1/key", headers=hdr, json={"name": "adm"}) as r:
+                    key = await r.json()
+                    assert key["accessKeyId"].startswith("GK")
+                async with sess.post(
+                    base + "/v1/bucket", headers=hdr, json={"globalAlias": "admin-bucket"}
+                ) as r:
+                    b = await r.json()
+                    assert "id" in b
+                async with sess.post(
+                    base + "/v1/bucket/allow",
+                    headers=hdr,
+                    json={
+                        "bucketId": b["id"],
+                        "accessKeyId": key["accessKeyId"],
+                        "permissions": {"read": True, "write": True, "owner": True},
+                    },
+                ) as r:
+                    assert r.status == 200
+                # the key works via S3
+                c = S3Client(endpoint, key["accessKeyId"], key["secretAccessKey"])
+                await c.put_object("admin-bucket", "x", b"via admin")
+                assert await c.get_object("admin-bucket", "x") == b"via admin"
+        finally:
+            await adm.stop()
+            await teardown(garage, s3)
+
+    run(main())
