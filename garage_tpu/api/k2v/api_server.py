@@ -1,0 +1,316 @@
+"""K2V REST API (reference src/api/k2v/ router.rs:15-51, item.rs,
+batch.rs, index.rs).
+
+  GET    /bucket                         ReadIndex (partition keys + counts)
+  POST   /bucket                         InsertBatch (JSON)
+  POST   /bucket?search                  ReadBatch (JSON)
+  POST   /bucket?delete                  DeleteBatch (JSON)
+  GET    /bucket/pk/sk                   ReadItem (raw or JSON per Accept)
+  GET    /bucket/pk/sk?poll&causality_token=..&timeout=..  PollItem
+  PUT    /bucket/pk/sk                   InsertItem (X-Garage-Causality-Token)
+  DELETE /bucket/pk/sk                   DeleteItem (token required)
+
+Values travel base64 in JSON bodies, raw in single-value responses.
+SigV4 auth + bucket permissions, same as S3.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import urllib.parse
+
+from aiohttp import web
+
+from ...model.k2v.item_table import CausalContext
+from ...utils.error import Error
+from ..common.error import ApiError, BadRequest, Forbidden, NoSuchKey, error_xml
+from ..common.signature import verify_request
+
+logger = logging.getLogger("garage.api.k2v")
+
+TOKEN_HEADER = "X-Garage-Causality-Token"
+
+
+class K2VApiServer:
+    def __init__(self, garage):
+        self.garage = garage
+        self.region = garage.config.s3_api.s3_region
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.router.add_route("*", "/{tail:.*}", self._entry)
+        self.runner: web.AppRunner | None = None
+
+    async def start(self, host: str, port: int) -> None:
+        self.runner = web.AppRunner(self.app, access_log=None)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, host, port)
+        await site.start()
+        logger.info("k2v api listening on %s:%d", host, port)
+
+    async def stop(self) -> None:
+        if self.runner:
+            await self.runner.cleanup()
+
+    async def _get_secret(self, key_id: str):
+        k = await self.garage.key_table.get(key_id.encode(), b"")
+        if k is None or k.is_deleted():
+            return None
+        return k.secret()
+
+    async def _entry(self, request: web.Request) -> web.StreamResponse:
+        try:
+            return await self._handle(request)
+        except ApiError as e:
+            return web.Response(
+                status=e.status,
+                text=error_xml(e, request.path),
+                content_type="application/xml",
+            )
+        except Error as e:
+            status = 404 if "not found" in str(e) else 500
+            return web.Response(status=status, text=str(e))
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed tokens / numbers / JSON bodies are caller errors
+            return web.Response(status=400, text=f"bad request: {e!r}")
+        except Exception as e:  # noqa: BLE001
+            logger.exception("k2v api error")
+            return web.Response(status=500, text=repr(e))
+
+    async def _handle(self, request: web.Request) -> web.StreamResponse:
+        ctx = await verify_request(request, self._get_secret, self.region)
+        api_key = await self.garage.helper.get_key(ctx.key_id)
+        # split the RAW path first so %2F inside keys survives, then
+        # unquote each segment
+        raw = request.raw_path.split("?")[0].lstrip("/")
+        parts = [urllib.parse.unquote(p) for p in raw.split("/", 2)]
+        bucket_name = parts[0]
+        if not bucket_name:
+            raise BadRequest("no bucket")
+        bucket_id = await self.garage.helper.resolve_bucket(bucket_name, api_key)
+        perm = api_key.bucket_permissions(bucket_id)
+        pk = parts[1] if len(parts) > 1 else None
+        sk = parts[2] if len(parts) > 2 else None
+        q = request.query
+        m = request.method
+
+        if pk is None or pk == "":
+            if m == "GET":
+                _req(perm.allow_read)
+                return await self._read_index(bucket_id, request)
+            if m == "POST":
+                _req(perm.allow_write)
+                if "delete" in q:
+                    return await self._delete_batch(bucket_id, request)
+                if "search" in q:
+                    _req(perm.allow_read)
+                    return await self._read_batch(bucket_id, request)
+                return await self._insert_batch(bucket_id, request)
+            raise BadRequest(f"unsupported {m} on bucket")
+
+        if sk is None:
+            raise BadRequest("missing sort key")
+
+        if m == "GET":
+            _req(perm.allow_read)
+            if "poll" in q:
+                return await self._poll_item(bucket_id, pk, sk, request)
+            return await self._read_item(bucket_id, pk, sk, request)
+        if m == "PUT":
+            _req(perm.allow_write)
+            body = await request.read()
+            causal = _token_of(request)
+            await self.garage.k2v_rpc.insert(bucket_id, pk, sk, causal, body)
+            return web.Response(status=204)
+        if m == "DELETE":
+            _req(perm.allow_write)
+            causal = _token_of(request)
+            if causal is None:
+                raise BadRequest("DeleteItem requires X-Garage-Causality-Token")
+            await self.garage.k2v_rpc.insert(bucket_id, pk, sk, causal, None)
+            return web.Response(status=204)
+        raise BadRequest(f"unsupported method {m}")
+
+    # --- item ops -------------------------------------------------------------
+
+    async def _read_item(self, bucket_id, pk, sk, request) -> web.Response:
+        item = await self.garage.k2v_item_table.get(
+            bucket_id + pk.encode(), sk.encode()
+        )
+        if item is None or item.is_tombstone():
+            raise NoSuchKey("item not found")
+        token = item.causal_context().serialize()
+        values = item.live_values()
+        accept = request.headers.get("Accept", "*/*")
+        if len(values) == 1 and "application/json" not in accept:
+            return web.Response(
+                body=values[0],
+                headers={TOKEN_HEADER: token},
+                content_type="application/octet-stream",
+            )
+        return web.json_response(
+            [base64.b64encode(v).decode() for v in values],
+            headers={TOKEN_HEADER: token},
+        )
+
+    async def _poll_item(self, bucket_id, pk, sk, request) -> web.Response:
+        token = request.query.get("causality_token", "")
+        timeout = min(float(request.query.get("timeout", "300")), 600.0)
+        causal = CausalContext.parse(token) if token else CausalContext()
+        item = await self.garage.k2v_rpc.poll_item(bucket_id, pk, sk, causal, timeout)
+        if item is None:
+            return web.Response(status=304)
+        values = item.live_values()
+        return web.json_response(
+            [base64.b64encode(v).decode() for v in values],
+            headers={TOKEN_HEADER: item.causal_context().serialize()},
+        )
+
+    # --- index + batches ------------------------------------------------------
+
+    async def _read_index(self, bucket_id, request) -> web.Response:
+        q = request.query
+        prefix = q.get("prefix", "")
+        limit = min(int(q.get("limit", "1000")), 1000)
+        start = q.get("start", "")
+        # partition keys live in the counter table, keyed (bucket, pk):
+        # an ordered distributed range read (reference index.rs)
+        begin = max(start, prefix).encode() if (start or prefix) else None
+        ents = await self.garage.k2v_counter_table.get_range(
+            bucket_id, begin, None, limit + 1
+        )
+        nodes = self.garage.system.layout_manager.history.current().storage_nodes()
+        seen = []
+        for ent in ents:
+            pk = ent.sk.decode(errors="replace")
+            if prefix and not pk.startswith(prefix):
+                break  # sorted: past the prefix range
+            vals = ent.aggregate(nodes)
+            if vals.get("items", 0) <= 0:
+                continue
+            seen.append((pk, vals))
+        truncated = len(seen) > limit
+        seen = seen[:limit]
+        return web.json_response(
+            {
+                "prefix": prefix or None,
+                "partitionKeys": [
+                    {
+                        "pk": pk,
+                        "entries": v.get("items", 0),
+                        "conflicts": v.get("conflicts", 0),
+                        "values": v.get("values", 0),
+                        "bytes": v.get("bytes", 0),
+                    }
+                    for pk, v in seen
+                ],
+                "more": truncated,
+            }
+        )
+
+    async def _insert_batch(self, bucket_id, request) -> web.Response:
+        body = json.loads(await request.read())
+        items = []
+        for it in body:
+            v = it.get("v")
+            items.append(
+                (
+                    it["pk"],
+                    it["sk"],
+                    CausalContext.parse(it["ct"]) if it.get("ct") else None,
+                    base64.b64decode(v) if v is not None else None,
+                )
+            )
+        await self.garage.k2v_rpc.insert_batch(bucket_id, items)
+        return web.Response(status=204)
+
+    async def _read_batch(self, bucket_id, request) -> web.Response:
+        body = json.loads(await request.read())
+        out = []
+        for search in body:
+            pk = search["partitionKey"]
+            start = search.get("start")
+            end = search.get("end")
+            limit = min(int(search.get("limit") or 1000), 1000)
+            items = await self.garage.k2v_item_table.get_range(
+                bucket_id + pk.encode(),
+                start.encode() if start else None,
+                "present",
+                limit + 1,
+            )
+            rows = []
+            more = False
+            next_start = None
+            for item in items:
+                if end is not None and item.sort_key >= end:
+                    break
+                if len(rows) >= limit:
+                    more = True
+                    next_start = item.sort_key
+                    break
+                rows.append(
+                    {
+                        "sk": item.sort_key,
+                        "ct": item.causal_context().serialize(),
+                        "v": [
+                            base64.b64encode(v).decode()
+                            for v in item.live_values()
+                        ],
+                    }
+                )
+            out.append(
+                {
+                    "partitionKey": pk,
+                    "start": start,
+                    "end": end,
+                    "limit": limit,
+                    "items": rows,
+                    "more": more,
+                    "nextStart": next_start,
+                }
+            )
+        return web.json_response(out)
+
+    async def _delete_batch(self, bucket_id, request) -> web.Response:
+        body = json.loads(await request.read())
+        deleted = []
+        for d in body:
+            pk = d["partitionKey"]
+            start = d.get("start")
+            end = d.get("end")
+            single = d.get("singleItem", False)
+            n = 0
+            cursor = start.encode() if start else None
+            while True:  # page through the FULL range
+                items = await self.garage.k2v_item_table.get_range(
+                    bucket_id + pk.encode(), cursor, "present", 1000
+                )
+                done = True
+                for item in items:
+                    if cursor is not None and item.sort_key.encode() < cursor:
+                        continue
+                    if single and item.sort_key != start:
+                        continue
+                    if end is not None and item.sort_key >= end:
+                        break
+                    await self.garage.k2v_rpc.insert(
+                        bucket_id, pk, item.sort_key, item.causal_context(), None
+                    )
+                    n += 1
+                else:
+                    done = len(items) < 1000
+                if done or single:
+                    break
+                cursor = items[-1].sort_key.encode() + b"\x00"
+            deleted.append({"partitionKey": pk, "deletedItems": n})
+        return web.json_response(deleted)
+
+
+def _token_of(request) -> CausalContext | None:
+    tok = request.headers.get(TOKEN_HEADER)
+    return CausalContext.parse(tok) if tok else None
+
+
+def _req(cond: bool) -> None:
+    if not cond:
+        raise Forbidden("access denied")

@@ -127,6 +127,13 @@ async def run_server(config_path: str) -> None:
         host, port = _parse_addr(config.s3_api.api_bind_addr)
         await s3.start(host, port)
         servers.append(s3)
+    if config.k2v_api.api_bind_addr:
+        from ..api.k2v.api_server import K2VApiServer
+
+        k2v = K2VApiServer(garage)
+        host, port = _parse_addr(config.k2v_api.api_bind_addr)
+        await k2v.start(host, port)
+        servers.append(k2v)
     if config.s3_web.bind_addr:
         from ..web.web_server import WebServer
 

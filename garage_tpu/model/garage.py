@@ -156,6 +156,17 @@ class Garage:
             self.system, self.object_counter_table, self.db
         )
         self.object_schema.counter = self.object_counter
+        from .index_counter import CounterTable as _CT, IndexCounter as _IC
+        from .k2v.item_table import K2VItemTable
+
+        self.k2v_counter_table = Table(
+            self.system, self.helper_rpc, self.db, _CT("k2v_index_counter"), sharded
+        )
+        self.k2v_counter = _IC(self.system, self.k2v_counter_table, self.db)
+        self.k2v_item_schema = K2VItemTable(counter=self.k2v_counter)
+        self.k2v_item_table = Table(
+            self.system, self.helper_rpc, self.db, self.k2v_item_schema, sharded
+        )
         self.bucket_table = Table(
             self.system, self.helper_rpc, self.db, BucketTable(), fullcopy
         )
@@ -166,6 +177,8 @@ class Garage:
             self.system, self.helper_rpc, self.db, KeyTable(), fullcopy
         )
         self.tables = [
+            self.k2v_counter_table,
+            self.k2v_item_table,
             self.object_counter_table,
             self.object_table,
             self.version_table,
@@ -177,8 +190,10 @@ class Garage:
         ]
 
         from .helper import GarageHelper
+        from .k2v.rpc import K2VRpcHandler
 
         self.helper = GarageHelper(self)
+        self.k2v_rpc = K2VRpcHandler(self)
         self.bg = BackgroundRunner()
         self._started = False
 

@@ -1,0 +1,146 @@
+"""K2V RPC: causal-timestamp allocation + quorum insert + poll pub/sub.
+
+Reference src/model/k2v/rpc.rs:74-205,373- — an insert is routed to ONE
+storage node of the item's partition (the first reachable in latency
+order), which allocates the DVVS dot under a local per-item lock and then
+fans the merged item out to the other replicas through the normal table
+path.  PollItem long-polls a local subscription until the item changes
+past the polled causality token (reference sub.rs SubscriptionManager).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ...net.message import PRIO_HIGH, Req, Resp
+from ...utils.error import Error
+from .item_table import CausalContext, K2VItem
+
+logger = logging.getLogger("garage.k2v")
+
+
+class SubscriptionManager:
+    def __init__(self):
+        self.subs: dict[tuple, list[asyncio.Event]] = {}
+
+    def _key(self, item: K2VItem) -> tuple:
+        return (item.bucket_id, item.partition_key, item.sort_key)
+
+    def notify(self, item: K2VItem) -> None:
+        for ev in self.subs.pop(self._key(item), []):
+            ev.set()
+
+    async def wait(self, bucket_id, pk, sk, timeout: float) -> bool:
+        ev = asyncio.Event()
+        self.subs.setdefault((bucket_id, pk, sk), []).append(ev)
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+
+class K2VRpcHandler:
+    def __init__(self, garage):
+        self.garage = garage
+        self.sub = SubscriptionManager()
+        garage.k2v_item_table.schema.sub_manager = self.sub
+        self.endpoint = garage.netapp.endpoint("k2v/rpc")
+        self.endpoint.set_handler(self._handle)
+        # fixed-size lock pool: serializes dot allocation per item without
+        # accumulating one lock per key forever
+        self._locks = [asyncio.Lock() for _ in range(256)]
+
+    # --- public API (called by the HTTP layer) --------------------------------
+
+    async def insert(
+        self,
+        bucket_id: bytes,
+        pk: str,
+        sk: str,
+        causal: CausalContext | None,
+        value: bytes | None,
+    ) -> None:
+        """Route to a storage node of the partition for dot allocation."""
+        h = self.garage.k2v_item_table.schema.partition_hash(
+            bucket_id + pk.encode()
+        )
+        nodes = self.garage.helper_rpc.request_order(
+            self.garage.k2v_item_table.replication.read_nodes(h)
+        )
+        errors = []
+        msg = [
+            "Insert",
+            bucket_id,
+            pk,
+            sk,
+            causal.serialize() if causal else None,
+            value,
+        ]
+        for n in nodes:
+            try:
+                await self.endpoint.call(n, msg, prio=PRIO_HIGH)
+                return
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{n.hex()[:8]}: {e!r}")
+        raise Error(f"k2v insert failed on all nodes: {errors}")
+
+    async def insert_batch(self, bucket_id: bytes, items: list) -> None:
+        """items: [(pk, sk, causal | None, value | None)] — fanned out
+        concurrently (bounded) instead of one round-trip per item."""
+        sem = asyncio.Semaphore(16)
+
+        async def one(pk, sk, causal, value):
+            async with sem:
+                await self.insert(bucket_id, pk, sk, causal, value)
+
+        await asyncio.gather(*[one(*it) for it in items])
+
+    async def poll_item(
+        self, bucket_id: bytes, pk: str, sk: str, causal: CausalContext, timeout: float
+    ) -> K2VItem | None:
+        """Wait until the item advances past `causal`; None on timeout."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            item = await self.garage.k2v_item_table.get(
+                bucket_id + pk.encode(), sk.encode()
+            )
+            if item is not None and _newer_than(item, causal):
+                return item
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                return None
+            await self.sub.wait(bucket_id, pk, sk, min(remaining, 5.0))
+
+    # --- rpc ------------------------------------------------------------------
+
+    async def _handle(self, from_id: bytes, req: Req) -> Resp:
+        op = req.body
+        if op[0] == "Insert":
+            bucket_id, pk, sk = bytes(op[1]), op[2], op[3]
+            causal = CausalContext.parse(op[4]) if op[4] else None
+            value = bytes(op[5]) if op[5] is not None else None
+            await self._local_insert(bucket_id, pk, sk, causal, value)
+            return Resp(None)
+        raise Error(f"unknown k2v rpc op {op[0]!r}")
+
+    async def _local_insert(self, bucket_id, pk, sk, causal, value) -> None:
+        table = self.garage.k2v_item_table
+        key = bucket_id + pk.encode() + b"\x00" + sk.encode()
+        from ...utils.data import blake2sum
+
+        lock = self._locks[blake2sum(key)[0]]
+        async with lock:
+            existing = await table.get(bucket_id + pk.encode(), sk.encode())
+            item = existing or K2VItem(bucket_id, pk, sk)
+            item.update(self.garage.node_id, causal, value)
+            await table.insert(item)
+
+
+def _newer_than(item: K2VItem, causal: CausalContext) -> bool:
+    vv = item.causal_context().vv
+    for node, t in vv.items():
+        if t > causal.vv.get(node, 0):
+            return True
+    return False
