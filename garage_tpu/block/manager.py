@@ -46,6 +46,20 @@ logger = logging.getLogger("garage.block")
 
 INLINE_THRESHOLD = 3072  # smaller objects inline in the object table
 
+# EC piece files carry the original block length (needed to strip the
+# codec's stripe padding at decode time): b"GTP1" + u64 len + piece bytes
+PIECE_MAGIC = b"GTP1"
+
+
+def wrap_piece(block_len: int, piece: bytes) -> bytes:
+    return PIECE_MAGIC + block_len.to_bytes(8, "big") + piece
+
+
+def unwrap_piece(stored: bytes) -> tuple[int, bytes]:
+    if stored[:4] != PIECE_MAGIC:
+        raise Error("not an EC piece file")
+    return int.from_bytes(stored[4:12], "big"), stored[12:]
+
 
 class BlockManager:
     def __init__(
@@ -228,6 +242,8 @@ class BlockManager:
                 # replica mode stores the block itself: verify before storing
                 if blake2sum(payload) != hash32:
                     raise Error("put payload does not match block hash")
+            if "l" in meta:  # fresh EC piece: wrap with its block length
+                payload = wrap_piece(int(meta["l"]), payload)
             await self.write_block_local(
                 hash32, payload, bool(meta.get("c")), piece=piece
             )
@@ -245,6 +261,9 @@ class BlockManager:
         if op[0] == "Need":
             hash32 = bytes(op[1])
             return Resp(self.rc.is_needed(hash32) and not self.has_block(hash32))
+        if op[0] == "Pieces":
+            hash32 = bytes(op[1])
+            return Resp(sorted(self.local_pieces(hash32).keys()))
         raise Error(f"unknown block op {op[0]!r}")
 
     # --- cluster ops ----------------------------------------------------------
@@ -281,7 +300,7 @@ class BlockManager:
             *[
                 self.endpoint.call(
                     n,
-                    ["Put", hash32, {"c": False, "p": i}, pieces[i]],
+                    ["Put", hash32, {"c": False, "p": i, "l": len(data)}, pieces[i]],
                     prio=PRIO_NORMAL,
                 )
                 for i, n in targets
@@ -309,7 +328,8 @@ class BlockManager:
 
     async def rpc_get_block(self, hash32: bytes, prio: int = PRIO_NORMAL) -> bytes:
         """Fetch a block: local first, then peers in latency order with
-        fallback (reference manager.rs:243-344)."""
+        fallback (reference manager.rs:243-344).  EC mode gathers k pieces
+        (data-piece fast path, any-k + decode on failure)."""
         if self.codec.n_pieces == 1:
             local = await self.read_block_local(hash32)
             if local is not None:
@@ -333,4 +353,169 @@ class BlockManager:
                 except Exception as e:  # noqa: BLE001
                     errors.append(f"{n.hex()[:8]}: {e!r}")
             raise Error(f"block {hash32.hex()[:16]} unavailable: {errors}")
-        raise NotImplementedError("EC read path lands with the model layer (M8)")
+        return await self._ec_get(hash32, prio)
+
+    async def _fetch_piece(
+        self, node: bytes, hash32: bytes, piece: int, prio
+    ) -> tuple[int, bytes]:
+        """-> (block_len, piece_bytes)"""
+        if node == self.system.id:
+            found = self.find_block_file(hash32, piece=piece)
+            if found is None:
+                raise Error("piece not local")
+            with open(found[0], "rb") as f:
+                stored = f.read()
+            if found[1]:
+                stored = zstandard.decompress(stored)
+            return unwrap_piece(stored)
+        resp = await self.endpoint.call(node, ["Get", hash32, piece], prio=prio)
+        _ok, meta, stored = resp.body
+        stored = bytes(stored)
+        if meta.get("c"):
+            stored = zstandard.decompress(stored)
+        return unwrap_piece(stored)
+
+    async def gather_pieces(
+        self, hash32: bytes, want_k: int, prio=PRIO_NORMAL, exclude_self=False
+    ) -> tuple[int, dict[int, bytes]]:
+        """Collect at least want_k distinct pieces -> (block_len, pieces)."""
+        nodes = self.system.layout_manager.history.current().nodes_of(hash32)
+        pieces: dict[int, bytes] = {}
+        block_len = -1
+        errors: list[str] = []
+        fetches = [
+            (i, nodes[i])
+            for i in range(min(want_k, len(nodes)))
+            if not (exclude_self and nodes[i] == self.system.id)
+        ]
+        results = await asyncio.gather(
+            *[self._fetch_piece(n, hash32, i, prio) for i, n in fetches],
+            return_exceptions=True,
+        )
+        for (i, n), r in zip(fetches, results):
+            if isinstance(r, Exception):
+                errors.append(f"piece {i}@{n.hex()[:8]}: {r!r}")
+            else:
+                block_len, pieces[i] = r
+        if len(pieces) < want_k:
+            # slow path: ask every node which pieces it holds, take any k
+            for n in self.helper.request_order(nodes):
+                if len(pieces) >= want_k:
+                    break
+                if exclude_self and n == self.system.id:
+                    continue
+                try:
+                    resp = await self.endpoint.call(n, ["Pieces", hash32], prio=prio)
+                    for pi in resp.body or []:
+                        pi = int(pi)
+                        if pi not in pieces:
+                            try:
+                                block_len, pieces[pi] = await self._fetch_piece(
+                                    n, hash32, pi, prio
+                                )
+                            except Exception as e:  # noqa: BLE001
+                                errors.append(f"piece {pi}@{n.hex()[:8]}: {e!r}")
+                        if len(pieces) >= want_k:
+                            break
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"pieces@{n.hex()[:8]}: {e!r}")
+        if len(pieces) < want_k:
+            raise Error(
+                f"block {hash32.hex()[:16]}: only {len(pieces)}/{want_k} "
+                f"pieces reachable: {errors}"
+            )
+        return block_len, pieces
+
+    async def _ec_get(self, hash32: bytes, prio) -> bytes:
+        """Gather k pieces and decode; the plaintext block hash is verified
+        after decode, so corrupted pieces are caught end-to-end."""
+        k = self.codec.min_pieces
+        blen, pieces = await self.gather_pieces(hash32, k, prio)
+        data = self.codec.decode(pieces, blen)
+        if blake2sum(data) != hash32:
+            raise Error("EC decode does not match block hash")
+        return data
+
+    def _verify_gathered(self, hash32: bytes, pieces: dict[int, bytes], blen: int):
+        """Reject reconstruction inputs whose decoded block doesn't match
+        the content hash — otherwise one corrupt surviving piece would be
+        laundered into freshly rebuilt pieces."""
+        if blake2sum(self.codec.decode(dict(pieces), blen)) != hash32:
+            raise Error(
+                f"block {hash32.hex()[:16]}: gathered pieces are corrupt"
+            )
+
+    async def reconstruct_local_piece(self, hash32: bytes) -> bool:
+        """Rebuild THIS node's piece from surviving peers (EC resync path).
+        Returns True if a piece was stored."""
+        nodes = self.system.layout_manager.history.current().nodes_of(hash32)
+        try:
+            my_rank = nodes.index(self.system.id)
+        except ValueError:
+            return False
+        if my_rank >= self.codec.n_pieces:
+            return False
+        blen, pieces = await self.gather_pieces(
+            hash32, self.codec.min_pieces, prio=PRIO_BACKGROUND, exclude_self=True
+        )
+        self._verify_gathered(hash32, pieces, blen)
+        rec = self.codec.reconstruct_pieces(pieces, [my_rank], blen)
+        await self.write_block_local(
+            hash32, wrap_piece(blen, rec[my_rank]), False, piece=my_rank
+        )
+        return True
+
+    async def bulk_reconstruct(self, hashes: list[bytes]) -> int:
+        """Batched EC repair: gather surviving pieces for MANY blocks
+        concurrently, run ONE grouped reconstruction through the codec
+        (TPU dispatch for large batches, BASELINE 10k-block resync
+        target), store the results.  Blocks that cannot be gathered are
+        queued for resync's retry/backoff loop.  Returns pieces rebuilt."""
+        nodes_of = self.system.layout_manager.history.current().nodes_of
+        todo: list[tuple[bytes, int]] = []
+        for h in hashes:
+            if not self.rc.is_needed(h):
+                continue  # never resurrect deleted blocks
+            nodes = nodes_of(h)
+            if self.system.id not in nodes:
+                continue
+            my_rank = nodes.index(self.system.id)
+            if my_rank >= self.codec.n_pieces or self.find_block_file(h, piece=my_rank):
+                continue
+            todo.append((h, my_rank))
+        if not todo:
+            return 0
+
+        sem = asyncio.Semaphore(16)
+
+        async def gather_one(h, rank):
+            async with sem:
+                try:
+                    blen, pieces = await self.gather_pieces(
+                        h, self.codec.min_pieces, prio=PRIO_BACKGROUND,
+                        exclude_self=True,
+                    )
+                    self._verify_gathered(h, pieces, blen)
+                    return (h, rank, pieces, blen)
+                except Error as e:
+                    logger.warning(
+                        "bulk repair: cannot gather %s (%r); queued for resync",
+                        h.hex()[:16], e,
+                    )
+                    self.resync.queue_block(h)
+                    return None
+
+        gathered = await asyncio.gather(*[gather_one(h, r) for h, r in todo])
+        batch = [g for g in gathered if g is not None]
+        if not batch:
+            return 0
+        recs = self.codec.reconstruct_batch(
+            [(pieces, [rank], blen) for _h, rank, pieces, blen in batch]
+        )
+        n = 0
+        for (h, rank, _p, blen), rec in zip(batch, recs):
+            await self.write_block_local(
+                h, wrap_piece(blen, rec[rank]), False, piece=rank
+            )
+            n += 1
+        return n

@@ -113,6 +113,51 @@ class BlockResyncManager:
         have = mgr.has_block(hash32)
         i_store = mgr.system.id in mgr.storage_nodes_of(hash32)
 
+        if mgr.codec.n_pieces > 1:
+            # EC mode: this node's unit of storage is ITS piece
+            nodes = mgr.system.layout_manager.history.current().nodes_of(hash32)
+            my_rank = nodes.index(mgr.system.id) if mgr.system.id in nodes else -1
+            is_holder = 0 <= my_rank < mgr.codec.n_pieces
+            local = mgr.local_pieces(hash32)
+            if needed and is_holder and my_rank not in local:
+                await mgr.reconstruct_local_piece(hash32)
+                logger.debug("resync: reconstructed piece for %s", hash32.hex()[:16])
+                return
+            if local and not needed and mgr.rc.is_deletable(hash32):
+                # block deleted: reclaim every local piece
+                for _pi, (path, _c) in local.items():
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                mgr.rc.clear_deleted(hash32)
+                logger.debug("resync: deleted pieces of %s", hash32.hex()[:16])
+                return
+            if local and needed and not is_holder:
+                # no longer a holder (layout change): delete only once the
+                # current holders can serve >= k distinct pieces without us
+                distinct: set[int] = set()
+                for n in nodes[: mgr.codec.n_pieces]:
+                    try:
+                        resp = await mgr.endpoint.call(
+                            n, ["Pieces", hash32], prio=PRIO_BACKGROUND
+                        )
+                        distinct.update(int(p) for p in resp.body or [])
+                    except Exception as e:
+                        raise RuntimeError(f"cannot check holders: {e!r}") from e
+                if len(distinct) >= mgr.codec.min_pieces:
+                    for _pi, (path, _c) in local.items():
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
+                else:
+                    raise RuntimeError(
+                        f"holders have only {len(distinct)} distinct pieces; "
+                        "keeping ours until they heal"
+                    )
+            return
+
         if needed and i_store and not have:
             data = await mgr.rpc_get_block(hash32, prio=PRIO_BACKGROUND)
             stored, compressed = mgr._maybe_compress(data)

@@ -225,7 +225,20 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             )
         cfg.replication_factor, cfg.consistency_mode = legacy[cfg.replication_mode]
         cfg.replication_mode = None
-    cfg.ec_params()  # validate ec:k:m syntax at parse time
+    ec = cfg.ec_params()  # validates ec:k:m syntax at parse time
+    if ec is not None:
+        # every block needs k+m distinct nodes: the layout's replication
+        # factor IS the stripe width (shard placement constraint).  An
+        # explicitly configured mismatching value is an error, not a
+        # silent override (it would change metadata quorums invisibly).
+        k, m = ec
+        if "replication_factor" in raw and cfg.replication_factor != k + m:
+            raise ValueError(
+                f"replication_mode {cfg.replication_mode!r} requires "
+                f"replication_factor = {k + m} (or omit it); got "
+                f"{cfg.replication_factor}"
+            )
+        cfg.replication_factor = k + m
     return cfg
 
 
