@@ -1,0 +1,126 @@
+"""Native (C++) host-side hot paths, loaded via ctypes.
+
+The reference's data path is native end to end (Rust); here the TPU runs
+the batched math and this extension covers the per-request host paths:
+GF(2^8) coding for single blocks and BLAKE3 hashing.  Built on demand with
+g++ (`python -m garage_tpu._native` or first import); every caller has a
+pure-Python/numpy fallback, so a missing toolchain degrades performance,
+never correctness.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+
+import numpy as np
+
+logger = logging.getLogger("garage.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libgarage_native.so")
+_SOURCES = ["gf8.cpp", "blake3.cpp"]
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def build(force: bool = False) -> str | None:
+    """Compile the extension; returns the .so path or None on failure."""
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    if not force and os.path.exists(_SO):
+        newest = max(os.path.getmtime(s) for s in srcs)
+        if os.path.getmtime(_SO) >= newest:
+            return _SO
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, *srcs,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
+        err = getattr(e, "stderr", b"")
+        logger.warning("native build failed (%r): %s", e, err.decode(errors="replace")[:500] if err else "")
+        return None
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    so = build()
+    if so is None:
+        return None
+    try:
+        l = ctypes.CDLL(so)
+        l.gf8_apply.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        l.blake3_hash.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+        l.blake3_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p
+        ]
+        _lib = l
+    except OSError as e:
+        logger.warning("cannot load native library: %r", e)
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# --- typed wrappers ----------------------------------------------------------
+
+
+def gf8_apply(mat: np.ndarray, shards: np.ndarray) -> np.ndarray | None:
+    """out (r, s) = mat (r, q) @ shards (q, s) over GF(2^8); None if the
+    native library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    r, q = mat.shape
+    q2, s = shards.shape
+    assert q == q2
+    mat_c = np.ascontiguousarray(mat, dtype=np.uint8)
+    sh_c = np.ascontiguousarray(shards, dtype=np.uint8)
+    out = np.zeros((r, s), dtype=np.uint8)
+    l.gf8_apply(
+        mat_c.ctypes.data_as(ctypes.c_char_p), r, q,
+        sh_c.ctypes.data_as(ctypes.c_char_p),
+        out.ctypes.data_as(ctypes.c_char_p), s,
+    )
+    return out
+
+
+def blake3(data: bytes) -> bytes | None:
+    l = lib()
+    if l is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    l.blake3_hash(data, len(data), out)
+    return out.raw
+
+
+def blake3_batch(x: np.ndarray) -> np.ndarray | None:
+    """x (n, each_len) uint8 -> (n, 32) digests; None if unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    n, each = x.shape
+    x_c = np.ascontiguousarray(x, dtype=np.uint8)
+    out = np.zeros((n, 32), dtype=np.uint8)
+    l.blake3_batch(
+        x_c.ctypes.data_as(ctypes.c_char_p), n, each,
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out
+
+
+if __name__ == "__main__":
+    print(build(force=True) or "BUILD FAILED")

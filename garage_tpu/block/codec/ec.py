@@ -53,9 +53,11 @@ class EcCodec(BlockCodec):
     # --- scalar API ----------------------------------------------------------
 
     def encode(self, block: bytes) -> list[bytes]:
-        data = self._split(block)[None]  # (1, k, s)
-        parity = gf.encode_blocks_ref(data, self.k, self.m)[0]
-        return [bytes(data[0, i]) for i in range(self.k)] + [
+        data = self._split(block)  # (k, s)
+        parity = gf.apply_matrix(
+            gf.cauchy_parity_matrix(self.k, self.m), data
+        )
+        return [bytes(data[i]) for i in range(self.k)] + [
             bytes(parity[i]) for i in range(self.m)
         ]
 
@@ -78,11 +80,12 @@ class EcCodec(BlockCodec):
             )
         use = present[: self.k]
         s = self.piece_len(block_len)
-        shards = np.stack([np.frombuffer(pieces[i], dtype=np.uint8) for i in use])[
-            None
-        ]  # (1, k, s)
+        shards = np.stack(
+            [np.frombuffer(pieces[i], dtype=np.uint8) for i in use]
+        )  # (k, s)
         assert shards.shape[-1] == s, (shards.shape, s)
-        rec = gf.reconstruct_blocks_ref(shards, self.k, self.m, use, want)[0]
+        rmat = gf.reconstruction_matrix(self.k, self.m, use, want)
+        rec = gf.apply_matrix(rmat, shards)
         return {w: bytes(rec[j]) for j, w in enumerate(want)}
 
     # --- batched API (TPU) ----------------------------------------------------

@@ -173,6 +173,19 @@ def apply_matrix_ref(coding: np.ndarray, shards: np.ndarray) -> np.ndarray:
     return out
 
 
+def apply_matrix(coding: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Native-accelerated coding apply: the C++ extension when available
+    (2-D operands), the numpy LUT reference otherwise.  Bit-identical to
+    apply_matrix_ref (cross-checked in tests)."""
+    if shards.ndim == 2:
+        from .. import _native
+
+        out = _native.gf8_apply(coding, shards)
+        if out is not None:
+            return out
+    return apply_matrix_ref(coding, shards)
+
+
 def encode_blocks_ref(data: np.ndarray, k: int, m: int) -> np.ndarray:
     """(..., k, S) data shards -> (..., m, S) parity shards."""
     return apply_matrix_ref(cauchy_parity_matrix(k, m), data)

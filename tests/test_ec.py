@@ -86,3 +86,27 @@ def test_split_block_padding():
     arr = gf.split_block(blk, 4)
     assert arr.shape[0] == 4
     assert bytes(arr.reshape(-1)[: len(blk)]) == blk
+
+
+def test_native_matches_reference():
+    """The C++ host codec and BLAKE3 must be bit-identical to the oracles
+    (skipped when no toolchain is available)."""
+    from garage_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native extension not built (no g++?)")
+    rng = np.random.default_rng(5)
+    mat = gf.cauchy_parity_matrix(8, 3)
+    shards = rng.integers(0, 256, (8, 5000), dtype=np.uint8)
+    assert np.array_equal(
+        _native.gf8_apply(mat, shards), gf.apply_matrix_ref(mat, shards)
+    )
+    from garage_tpu.ops.blake3_ref import blake3 as py_blake3
+
+    for n in [0, 1, 64, 1023, 1024, 1025, 4096, 5000, 100000]:
+        d = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        assert _native.blake3(d) == py_blake3(d), f"len {n}"
+    batch = rng.integers(0, 256, (7, 2048), dtype=np.uint8)
+    got = _native.blake3_batch(batch)
+    for i in range(7):
+        assert bytes(got[i]) == py_blake3(bytes(batch[i]))
