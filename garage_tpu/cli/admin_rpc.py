@@ -208,6 +208,15 @@ class AdminRpcHandler:
             for wid, info in self.garage.bg.worker_info().items()
         ]
 
+    async def op_worker_get(self, args) -> Any:
+        if args.get("var"):
+            return {args["var"]: self.garage.bg_vars.get(args["var"])}
+        return self.garage.bg_vars.all()
+
+    async def op_worker_set(self, args) -> Any:
+        self.garage.bg_vars.set(args["var"], args["value"])
+        return {args["var"]: self.garage.bg_vars.get(args["var"])}
+
     async def op_repair(self, args) -> Any:
         what = args.get("what", "blocks")
         from ..block.repair import RebalanceWorker, RepairWorker

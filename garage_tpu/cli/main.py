@@ -92,11 +92,22 @@ def main(argv=None):
     kdel.add_argument("key")
 
     wrk = sub.add_parser("worker")
-    wrk.add_argument("worker_cmd", choices=["list"])
+    wrk.add_argument("worker_cmd", choices=["list", "get", "set"])
+    wrk.add_argument("var", nargs="?")
+    wrk.add_argument("value", nargs="?")
     rep = sub.add_parser("repair")
     rep.add_argument("what", choices=["blocks", "rebalance", "tables"])
     meta = sub.add_parser("meta")
     meta.add_argument("meta_cmd", choices=["snapshot"])
+    cdb = sub.add_parser("convert-db", help="copy the metadata db between engines")
+    cdb.add_argument("--input", required=True, help="src db path")
+    cdb.add_argument("--input-engine", default="sqlite")
+    cdb.add_argument("--output", required=True, help="dst db path")
+    cdb.add_argument("--output-engine", default="sqlite")
+    orep = sub.add_parser(
+        "offline-repair", help="run repairs without a running daemon"
+    )
+    orep.add_argument("what", choices=["tables", "blocks", "rebalance"])
 
     args = ap.parse_args(argv)
 
@@ -107,7 +118,63 @@ def main(argv=None):
 
     if args.cmd == "server":
         return asyncio.run(run_server(args.config))
+    if args.cmd == "convert-db":
+        return convert_db(args)
+    if args.cmd == "offline-repair":
+        return asyncio.run(offline_repair(args))
     return asyncio.run(run_cli(args))
+
+
+def convert_db(args) -> None:
+    """Copy every tree between db engines (reference cli/convert_db.rs)."""
+    from ..db import open_db
+
+    src = open_db(args.input, engine=args.input_engine)
+    dst = open_db(args.output, engine=args.output_engine)
+    total = 0
+    for name in src.list_trees():
+        st, dt = src.open_tree(name), dst.open_tree(name)
+        n = 0
+        for k, v in st.iter_range():
+            dt.insert(k, v)
+            n += 1
+        total += n
+        print(f"  {name}: {n} entries")
+    src.close()
+    dst.close()
+    print(f"converted {total} entries")
+
+
+async def offline_repair(args) -> None:
+    """Boot Garage WITHOUT network servers and run a repair pass
+    (reference src/garage/repair/offline.rs:11-40)."""
+    from ..block.repair import RebalanceWorker, RepairWorker
+    from ..utils.background import WorkerState
+
+    config = read_config(args.config)
+    garage = Garage(config)
+    # no garage.start(): no listener, no peering — local-only repairs
+    try:
+        if args.what == "tables":
+            for t in garage.tables:
+                # rebuild merkle trees from scratch locally
+                n = 0
+                for key, vh in list(t.data.merkle_todo.iter_range()):
+                    t.merkle.update_item(key, vh)
+                    t.data.merkle_todo.remove(key)
+                    n += 1
+                print(f"{t.schema.table_name}: {n} merkle items")
+        else:
+            w = (
+                RepairWorker(garage.block_manager)
+                if args.what == "blocks"
+                else RebalanceWorker(garage.block_manager)
+            )
+            while await w.work() != WorkerState.DONE:
+                pass
+            print(f"offline {args.what} repair done: {w.status()}")
+    finally:
+        await garage.stop()
 
 
 async def run_server(config_path: str) -> None:
@@ -323,6 +390,12 @@ async def dispatch(args, call, config) -> str | None:
         if kc == "delete":
             return str(await call("key-delete", {"key": args.key}))
 
+    if args.cmd == "worker" and args.worker_cmd == "get":
+        return json.dumps(await call("worker-get", {"var": args.var}))
+    if args.cmd == "worker" and args.worker_cmd == "set":
+        return json.dumps(
+            await call("worker-set", {"var": args.var, "value": args.value})
+        )
     if args.cmd == "worker":
         ws = await call("worker-list")
         rows = ["id\tname\tstate\terrors\tinfo"]

@@ -194,6 +194,23 @@ class Garage:
 
         self.helper = GarageHelper(self)
         self.k2v_rpc = K2VRpcHandler(self)
+
+        # runtime-tunable variables (reference util/background/vars.rs,
+        # `garage worker get/set`)
+        from ..utils.background import BgVars
+
+        self.bg_vars = BgVars()
+        resync = self.block_manager.resync
+        self.bg_vars.register_rw(
+            "resync-tranquility",
+            lambda: str(resync.tranquility),
+            lambda v: setattr(resync, "tranquility", int(v)),
+        )
+        self.bg_vars.register_rw(
+            "resync-worker-count",
+            lambda: str(resync.n_workers),
+            lambda v: setattr(resync, "n_workers", max(1, min(8, int(v)))),
+        )
         self.bg = BackgroundRunner()
         self._started = False
 

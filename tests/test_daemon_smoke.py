@@ -171,3 +171,113 @@ def test_three_node_smoke(tmp_path):
             out = p.stdout.read() if p.stdout else ""
             if out:
                 print(f"--- node{i} output ---\n{out[-3000:]}")
+
+
+@pytest.mark.slow
+def test_chaos_node_crash_during_writes(tmp_path):
+    """Jepsen-lite (reference script/jepsen.garage nemeses): writers keep
+    writing through a node crash + restart; every ACKED write must be
+    readable afterwards (read-after-write at quorum), and the restarted
+    node converges via anti-entropy."""
+    n = 3
+    rpc_ports = [free_port() for _ in range(n)]
+    s3_ports = [free_port() for _ in range(n)]
+    cfgs = [write_config(tmp_path, i, rpc_ports[i], s3_ports[i], []) for i in range(n)]
+
+    def start(i):
+        return subprocess.Popen(
+            [sys.executable, "-m", "garage_tpu.cli", "-c", str(cfgs[i]), "server"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    procs = [start(i) for i in range(n)]
+    try:
+        deadline = time.time() + 60
+        ids = []
+        for i in range(n):
+            while True:
+                try:
+                    ids.append(cli(cfgs[i], "node", "id").split("@")[0])
+                    break
+                except (RuntimeError, subprocess.TimeoutExpired):
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+        for j in (1, 2):
+            for _ in range(30):
+                try:
+                    cli(cfgs[0], "node", "connect", f"{ids[j]}@127.0.0.1:{rpc_ports[j]}")
+                    break
+                except RuntimeError:
+                    time.sleep(1.0)
+        for i in range(n):
+            cli(cfgs[0], "layout", "assign", ids[i], "-z", "dc1", "-s", "1G")
+        cli(cfgs[0], "layout", "apply")
+        out = cli(cfgs[0], "key", "new", "--name", "chaos")
+        key_id = out.split("Key ID: ")[1].splitlines()[0].strip()
+        secret = out.split("Secret key: ")[1].splitlines()[0].strip()
+        cli(cfgs[0], "bucket", "create", "chaos")
+        cli(cfgs[0], "bucket", "allow", "chaos", "--key", key_id,
+            "--read", "--write", "--owner")
+
+        from garage_tpu.api.s3.client import S3Client, S3Error
+
+        async def chaos():
+            c0 = S3Client(f"http://127.0.0.1:{s3_ports[0]}", key_id, secret)
+            acked: dict[str, bytes] = {}
+
+            async def writer(w):
+                for i in range(30):
+                    k = f"w{w}/obj{i:03d}"
+                    body = os.urandom(9000)
+                    try:
+                        await c0.put_object("chaos", k, body)
+                        acked[k] = body  # only acked writes must survive
+                    except S3Error:
+                        pass
+                    await asyncio.sleep(0.02)
+
+            writers = [asyncio.create_task(writer(w)) for w in range(3)]
+            await asyncio.sleep(0.4)
+            # nemesis: crash node2 mid-stream, restart it a bit later
+            procs[2].kill()
+            procs[2].wait(timeout=10)
+            await asyncio.sleep(1.0)
+            procs[2] = start(2)
+            await asyncio.gather(*writers)
+
+            # wait for node2 to come back, then verify EVERY acked write
+            # reads correctly through each surviving S3 endpoint
+            for _ in range(60):
+                try:
+                    cli(cfgs[2], "status", timeout=10)
+                    break
+                except (RuntimeError, subprocess.TimeoutExpired):
+                    await asyncio.sleep(1.0)
+            bad = []
+            for ep in (s3_ports[0], s3_ports[1]):
+                c = S3Client(f"http://127.0.0.1:{ep}", key_id, secret)
+                for k, body in acked.items():
+                    try:
+                        got = await c.get_object("chaos", k)
+                        if got != body:
+                            bad.append((ep, k, "mismatch"))
+                    except S3Error as e:
+                        bad.append((ep, k, repr(e)))
+                await c.close()
+            await c0.close()
+            assert not bad, f"{len(bad)} acked writes lost/corrupt: {bad[:5]}"
+            return len(acked)
+
+        n_acked = asyncio.run(chaos())
+        assert n_acked >= 60, f"too few acked writes: {n_acked}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
