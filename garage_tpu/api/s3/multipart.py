@@ -28,13 +28,19 @@ from .xml_util import xml_doc
 
 
 async def handle_create_multipart_upload(garage, bucket_id, key, request):
+    from .encryption import EncryptionParams
+
+    enc = EncryptionParams.from_headers(request.headers)
     upload_id = gen_uuid()
     headers = [
         [h.lower(), v]
         for h, v in request.headers.items()
         if h.lower() in SAVED_HEADERS
     ]
-    mpu = MultipartUpload(upload_id, bucket_id, key, timestamp=now_msec())
+    mpu = MultipartUpload(
+        upload_id, bucket_id, key, timestamp=now_msec(),
+        enc=enc.meta() if enc else None,
+    )
     await garage.mpu_table.insert(mpu)
     # an uploading object version marks the in-flight upload in listings
     ov = ObjectVersion(
@@ -70,6 +76,13 @@ async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
         raise BadRequest("partNumber must be in 1..10000")
     mpu = await _get_mpu(garage, bucket_id, key, q.get("uploadId", ""))
 
+    from ..common.checksum import ChecksumRequest
+    from .encryption import EncryptionParams, check_match
+
+    enc = EncryptionParams.from_headers(request.headers)
+    check_match(mpu.enc, enc)  # SSE-C fixed at create; parts must match
+    cks = ChecksumRequest.from_headers(request.headers)
+
     vid = gen_uuid()  # this part's own version
     await garage.version_table.insert(Version(vid, bucket_id, key))
     from .objects import stream_blocks
@@ -78,8 +91,11 @@ async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
         md5_hex, sha, total = await stream_blocks(
             garage, vid, bucket_id, key, part_number,
             request.content, garage.config.block_size,
+            transform=enc.encrypt_block if enc else None, extra_hash=cks,
         )
         _check_sha256(ctx, sha)
+        if cks is not None:
+            cks.verify()
     except BaseException:
         await garage.version_table.insert(
             Version.deleted_marker(vid, bucket_id, key)
@@ -138,20 +154,23 @@ async def handle_complete_multipart_upload(garage, bucket_id, key, request, ctx=
         for (p_pn, off), blk in pv.sorted_blocks():
             final.blocks.put([pn, off], {"h": blk["h"], "s": blk["s"]})
             total += blk["s"]
+            if mpu.enc is not None:
+                from .encryption import OVERHEAD
+
+                total -= OVERHEAD  # meta size is plaintext
     await garage.version_table.insert(final)
     # fresh refs for the final version BEFORE tombstoning part versions
     for _k, blk in final.sorted_blocks():
         await garage.block_ref_table.insert(BlockRef(blk["h"], final.uuid))
     etag = f"{etags_md5.hexdigest()}-{len(req_parts)}"
+    meta = {"size": total, "etag": etag, "headers": []}
+    if mpu.enc is not None:
+        meta["enc"] = mpu.enc
     ov = ObjectVersion(
         mpu.upload_id,
         mpu.timestamp,
         "complete",
-        {
-            "t": "first_block",
-            "vid": final.uuid,
-            "meta": {"size": total, "etag": etag, "headers": []},
-        },
+        {"t": "first_block", "vid": final.uuid, "meta": meta},
     )
     await garage.object_table.insert(Object(bucket_id, key, [ov]))
     # tombstone part versions (incl. stale re-uploads) and close the mpu

@@ -97,7 +97,7 @@ async def check_quotas(garage, bucket_id: bytes, key: str, new_size: int) -> Non
 
 async def stream_blocks(
     garage, vid: bytes, bucket_id: bytes, key: str, part_number: int,
-    body, block_size: int, first: bytes = b"",
+    body, block_size: int, first: bytes = b"", transform=None, extra_hash=None,
 ):
     """THE block-write pipeline shared by PutObject and UploadPart:
     chunk the body, store blocks with bounded parallelism
@@ -111,10 +111,11 @@ async def stream_blocks(
     inflight: set[asyncio.Task] = set()
 
     async def put_one(block: bytes, block_offset: int):
-        h = blake2sum(block)
-        await garage.block_manager.rpc_put_block(h, block)
+        stored = transform(block) if transform else block
+        h = blake2sum(stored)
+        await garage.block_manager.rpc_put_block(h, stored)
         v = Version(vid, bucket_id, key)
-        v.blocks.put([part_number, block_offset], {"h": h, "s": len(block)})
+        v.blocks.put([part_number, block_offset], {"h": h, "s": len(stored)})
         await garage.version_table.insert(v)
         await garage.block_ref_table.insert(BlockRef(h, vid))
 
@@ -137,6 +138,8 @@ async def stream_blocks(
                 block, buf = buf[:block_size], buf[block_size:]
                 md5.update(block)
                 sha.update(block)
+                if extra_hash is not None:
+                    extra_hash.update(block)
                 await launch(block, offset)
                 offset += len(block)
                 total += len(block)
@@ -147,6 +150,8 @@ async def stream_blocks(
         if buf:
             md5.update(buf)
             sha.update(buf)
+            if extra_hash is not None:
+                extra_hash.update(buf)
             await launch(buf, offset)
             total += len(buf)
         if inflight:
@@ -161,6 +166,11 @@ async def stream_blocks(
 async def handle_put_object(
     garage, bucket_id: bytes, key: str, request, ctx=None
 ) -> web.Response:
+    from ..common.checksum import ChecksumRequest
+    from .encryption import EncryptionParams
+
+    enc = EncryptionParams.from_headers(request.headers)
+    cks = ChecksumRequest.from_headers(request.headers)
     headers = [
         [h, request.headers[h_orig]]
         for h in SAVED_HEADERS
@@ -177,18 +187,25 @@ async def handle_put_object(
         _check_sha256(ctx, sha)
         await check_quotas(garage, bucket_id, key, len(first))
         etag = hashlib.md5(first).hexdigest()
+        meta = {"size": len(first), "etag": etag, "headers": headers}
+        if cks is not None:
+            cks.update(first)
+            meta["cks"] = cks.verify()
+        stored = first
+        if enc is not None:
+            stored = enc.encrypt_block(first)
+            meta["enc"] = enc.meta()
         version = ObjectVersion(
             gen_uuid(),
             now_msec(),
             "complete",
-            {
-                "t": "inline",
-                "bytes": first,
-                "meta": {"size": len(first), "etag": etag, "headers": headers},
-            },
+            {"t": "inline", "bytes": stored, "meta": meta},
         )
         await garage.object_table.insert(Object(bucket_id, key, [version]))
-        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+        resp_headers = {"ETag": f'"{etag}"'}
+        if enc is not None:
+            resp_headers.update(enc.response_headers())
+        return web.Response(status=200, headers=resp_headers)
 
     # multi-block object
     vid = gen_uuid()
@@ -200,24 +217,27 @@ async def handle_put_object(
 
     try:
         md5_hex, sha, total = await stream_blocks(
-            garage, vid, bucket_id, key, 0, body, block_size, first=buf_first
+            garage, vid, bucket_id, key, 0, body, block_size, first=buf_first,
+            transform=enc.encrypt_block if enc else None, extra_hash=cks,
         )
         _check_sha256(ctx, sha)
         await check_quotas(garage, bucket_id, key, total)
 
         etag = md5_hex
+        meta = {"size": total, "etag": etag, "headers": headers}
+        if cks is not None:
+            meta["cks"] = cks.verify()
+        if enc is not None:
+            meta["enc"] = enc.meta()
         final = ObjectVersion(
-            vid,
-            ts,
-            "complete",
-            {
-                "t": "first_block",
-                "vid": vid,
-                "meta": {"size": total, "etag": etag, "headers": headers},
-            },
+            vid, ts, "complete",
+            {"t": "first_block", "vid": vid, "meta": meta},
         )
         await garage.object_table.insert(Object(bucket_id, key, [final]))
-        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+        resp_headers = {"ETag": f'"{etag}"'}
+        if enc is not None:
+            resp_headers.update(enc.response_headers())
+        return web.Response(status=200, headers=resp_headers)
     except BaseException:
         # InterruptedCleanup (reference put.rs:217-223): mark aborted so
         # the cascade reclaims stored blocks
@@ -239,6 +259,8 @@ def _pick_version(obj: Object | None) -> ObjectVersion:
 
 
 def _meta_headers(version: ObjectVersion) -> dict[str, str]:
+    from ..common.checksum import response_headers as _cks_headers
+
     meta = version.data.get("meta", {})
     out = {
         "ETag": f'"{meta.get("etag", "")}"',
@@ -249,6 +271,7 @@ def _meta_headers(version: ObjectVersion) -> dict[str, str]:
     }
     for name, value in meta.get("headers", []):
         out[name.title()] = value
+    out.update(_cks_headers(meta))
     return out
 
 
@@ -293,12 +316,18 @@ def _parse_range(request, size: int) -> tuple[int, int] | None:
 async def handle_get_object(
     garage, bucket_id: bytes, key: str, request, head_only: bool = False
 ) -> web.StreamResponse:
+    from .encryption import OVERHEAD, EncryptionParams, check_match
+
     obj = await garage.object_table.get(bucket_id, key.encode())
     version = _pick_version(obj)
     _check_conditionals(request, version)
     meta = version.data.get("meta", {})
+    enc_params = EncryptionParams.from_headers(request.headers)
+    check_match(meta.get("enc"), enc_params)
     size = meta.get("size", 0)
     headers = _meta_headers(version)
+    if enc_params is not None:
+        headers.update(enc_params.response_headers())
 
     rng = _parse_range(request, size) if not head_only else None
     status = 200
@@ -313,6 +342,8 @@ async def handle_get_object(
 
     if version.data.get("t") == "inline":
         data = version.data["bytes"]
+        if enc_params is not None:
+            data = enc_params.decrypt_block(data)
         if rng is not None:
             data = data[rng[0] : rng[1]]
         return web.Response(status=status, body=data, headers=headers)
@@ -334,9 +365,11 @@ async def handle_get_object(
     pos = 0
     next_task: asyncio.Task | None = None
     try:
+        # plaintext extents: encrypted blocks carry OVERHEAD framing bytes
         wanted: list[tuple[int, int, bytes]] = []  # (blk_start, blk_end, hash)
         for (_part, _off), blk in blocks:
-            b_start, b_end = pos, pos + blk["s"]
+            plain_len = blk["s"] - (OVERHEAD if enc_params is not None else 0)
+            b_start, b_end = pos, pos + plain_len
             pos = b_end
             if b_end <= start or b_start >= end:
                 continue
@@ -347,6 +380,8 @@ async def handle_get_object(
                 next_task = None
             if i + 1 < len(wanted):
                 next_task = asyncio.create_task(fetch(wanted[i + 1][2]))
+            if enc_params is not None:
+                data = enc_params.decrypt_block(data)
             lo = max(start - b_start, 0)
             hi = min(end, b_end) - b_start
             await resp.write(data[lo:hi])

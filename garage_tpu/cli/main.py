@@ -130,14 +130,27 @@ def convert_db(args) -> None:
     from ..db import open_db
 
     src = open_db(args.input, engine=args.input_engine)
-    dst = open_db(args.output, engine=args.output_engine)
+    dst = open_db(args.output, engine=args.output_engine, fsync=False)
     total = 0
     for name in src.list_trees():
         st, dt = src.open_tree(name), dst.open_tree(name)
         n = 0
+        batch: list[tuple[bytes, bytes]] = []
+
+        def flush(items=None):
+            items = batch if items is None else items
+            if items:
+                dst.transaction(
+                    lambda tx: [tx.insert(dt, k, v) for k, v in items] and None
+                )
+                items.clear()
+
         for k, v in st.iter_range():
-            dt.insert(k, v)
+            batch.append((k, v))
             n += 1
+            if len(batch) >= 1000:
+                flush()
+        flush()
         total += n
         print(f"  {name}: {n} entries")
     src.close()
@@ -172,7 +185,19 @@ async def offline_repair(args) -> None:
             )
             while await w.work() != WorkerState.DONE:
                 pass
-            print(f"offline {args.what} repair done: {w.status()}")
+            # replica-mode repair enqueues into the resync queue: drain it
+            # here (no background workers run offline); peers are
+            # unreachable, so only local work (deletes, verifies) succeeds
+            # and the rest stays queued for the next daemon start
+            drained = 0
+            while await garage.block_manager.resync.resync_iter():
+                drained += 1
+            print(
+                f"offline {args.what} repair done: {w.status()}, "
+                f"{drained} resync items processed "
+                f"({garage.block_manager.resync.queue_len()} left for the "
+                "running daemon)"
+            )
     finally:
         await garage.stop()
 

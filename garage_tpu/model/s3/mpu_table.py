@@ -24,6 +24,7 @@ class MultipartUpload:
         timestamp: int = 0,
         parts: CrdtMap | None = None,
         deleted: Bool | None = None,
+        enc: dict | None = None,
     ):
         self.upload_id = upload_id
         self.bucket_id = bucket_id
@@ -31,6 +32,7 @@ class MultipartUpload:
         self.timestamp = timestamp
         self.parts = parts or CrdtMap()
         self.deleted = deleted or Bool(False)
+        self.enc = enc  # SSE-C {"alg","md5"} fixed at CreateMultipartUpload
 
     def merge(self, other: "MultipartUpload") -> None:
         self.deleted.merge(other.deleted)
@@ -39,6 +41,8 @@ class MultipartUpload:
         else:
             self.parts.merge(other.parts)
         self.timestamp = max(self.timestamp, other.timestamp) if self.timestamp else other.timestamp
+        if self.enc is None:
+            self.enc = other.enc
 
     def latest_parts(self) -> dict[int, dict]:
         """part_number -> newest {"vid","etag","size"}."""
@@ -60,6 +64,7 @@ class MultipartUpload:
             self.timestamp,
             self.parts.to_obj(),
             self.deleted.to_obj(),
+            self.enc,
         ]
 
 
@@ -82,7 +87,7 @@ class MpuTable(TableSchema):
                 v["vid"] = bytes(v["vid"])
         return MultipartUpload(
             bytes(obj[0]), bytes(obj[1]), obj[2], int(obj[3]), parts,
-            Bool.from_obj(obj[5]),
+            Bool.from_obj(obj[5]), obj[6] if len(obj) > 6 else None,
         )
 
     def merge_entries(self, a, b):
