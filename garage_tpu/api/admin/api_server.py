@@ -114,6 +114,9 @@ class AdminApiServer:
             lines.append(
                 f'worker_errors{{worker="{info.name}"}} {info.errors}'
             )
+        from ...utils.metrics import registry
+
+        lines.extend(registry.render())
         return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
 
     # --- v1 admin -------------------------------------------------------------

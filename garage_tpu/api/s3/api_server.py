@@ -89,8 +89,14 @@ class S3ApiServer:
         return k.secret()
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
+        from ...utils.metrics import registry
+
+        registry.incr("api_s3_request_counter", (("method", request.method),))
         try:
-            return await self._handle(request)
+            with registry.timer(
+                "api_s3_request_duration", (("method", request.method),)
+            ):
+                return await self._handle(request)
         except ApiError as e:
             if e.status == 304:
                 return web.Response(status=304)
@@ -122,6 +128,19 @@ class S3ApiServer:
             )
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
+        # PostObject: browser form uploads authenticate via a signed policy
+        # document in the form fields, not an Authorization header
+        if (
+            request.method == "POST"
+            and "Authorization" not in request.headers
+            and request.content_type == "multipart/form-data"
+        ):
+            from .post_object import handle_post_object
+
+            bucket_name, key = self._parse_target(request)
+            if bucket_name and not key:
+                return await handle_post_object(self, bucket_name, request)
+
         ctx = await verify_request(request, self._get_secret, self.region)
         api_key: Key = await self.garage.helper.get_key(ctx.key_id)
         bucket_name, key = self._parse_target(request)

@@ -43,10 +43,13 @@ class Endpoint:
         stream: AsyncIterator[bytes] | None = None,
         order_tag=None,
     ) -> Resp:
-        return await self.netapp.call(
-            target, self.path, Req(msg, stream=stream, order_tag=order_tag),
-            prio=prio, timeout=timeout,
-        )
+        from ..utils.metrics import registry
+
+        with registry.timer("rpc_request_duration", (("endpoint", self.path),)):
+            return await self.netapp.call(
+                target, self.path, Req(msg, stream=stream, order_tag=order_tag),
+                prio=prio, timeout=timeout,
+            )
 
 
 class NetApp:
@@ -77,7 +80,10 @@ class NetApp:
         ep = self.endpoints.get(path)
         if ep is None or ep.handler is None:
             raise RpcError(f"no handler for endpoint {path!r}")
-        return await ep.handler(from_id, req)
+        from ..utils.metrics import registry
+
+        with registry.timer("rpc_handle_duration", (("endpoint", path),)):
+            return await ep.handler(from_id, req)
 
     # --- connections ---------------------------------------------------------
 

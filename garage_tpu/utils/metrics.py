@@ -1,0 +1,64 @@
+"""Lightweight in-process metrics registry (reference: OpenTelemetry
+meters exported via the admin Prometheus endpoint, src/util/metrics.rs +
+doc/book/reference-manual/monitoring.md).
+
+Counters and duration summaries keyed (name, labels); rendered into
+Prometheus exposition text by the admin API.  No external deps, negligible
+hot-path cost (a dict update per observation).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self.counters: dict[tuple, float] = defaultdict(float)
+        self.durations: dict[tuple, list] = defaultdict(lambda: [0, 0.0])
+
+    def incr(self, name: str, labels: tuple = (), by: float = 1) -> None:
+        self.counters[(name, labels)] += by
+
+    def observe(self, name: str, labels: tuple, seconds: float) -> None:
+        d = self.durations[(name, labels)]
+        d[0] += 1
+        d[1] += seconds
+
+    def timer(self, name: str, labels: tuple = ()):
+        return _Timer(self, name, labels)
+
+    def render(self) -> list[str]:
+        lines = []
+        for (name, labels), v in sorted(self.counters.items()):
+            lines.append(f"{name}{_fmt(labels)} {v:g}")
+        for (name, labels), (n, total) in sorted(self.durations.items()):
+            lines.append(f"{name}_count{_fmt(labels)} {n}")
+            lines.append(f"{name}_seconds_total{_fmt(labels)} {total:.6f}")
+        return lines
+
+
+def _fmt(labels: tuple) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+
+
+class _Timer:
+    def __init__(self, m: Metrics, name: str, labels: tuple):
+        self.m, self.name, self.labels = m, name, labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.m.observe(self.name, self.labels, time.perf_counter() - self.t0)
+        if exc_type is not None:
+            self.m.incr(self.name + "_errors", self.labels)
+        return False
+
+
+# the process-wide registry (one storage daemon per process)
+registry = Metrics()

@@ -696,3 +696,85 @@ def test_sse_c_multipart(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_post_object_form_upload(tmp_path):
+    """PostObject: browser form upload with a signed policy document."""
+
+    async def main():
+        import base64
+        import hashlib
+        import hmac as hmac_mod
+        import json
+        from datetime import datetime, timedelta, timezone
+
+        import aiohttp
+
+        from garage_tpu.api.common.signature import signing_key
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("forms")
+
+            now = datetime.now(timezone.utc)
+            date = now.strftime("%Y%m%d")
+            cred = f"{client.key_id}/{date}/garage/s3/aws4_request"
+
+            def mk_form(policy_dict, key_field, file_bytes, sign_with=None):
+                policy_b64 = base64.b64encode(
+                    json.dumps(policy_dict).encode()
+                ).decode()
+                sig = hmac_mod.new(
+                    signing_key(sign_with or client.secret, date, "garage", "s3"),
+                    policy_b64.encode(),
+                    hashlib.sha256,
+                ).hexdigest()
+                form = aiohttp.FormData()
+                form.add_field("key", key_field)
+                form.add_field("x-amz-credential", cred)
+                form.add_field("x-amz-algorithm", "AWS4-HMAC-SHA256")
+                form.add_field("x-amz-signature", sig)
+                form.add_field("policy", policy_b64)
+                form.add_field("file", file_bytes, filename="upload.bin")
+                return form
+
+            policy = {
+                "expiration": (now + timedelta(hours=1)).strftime(
+                    "%Y-%m-%dT%H:%M:%S.000Z"
+                ),
+                "conditions": [
+                    {"bucket": "forms"},
+                    ["starts-with", "$key", "user/"],
+                    ["content-length-range", 0, 100000],
+                ],
+            }
+            payload = os.urandom(20_000)
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    endpoint + "/forms", data=mk_form(policy, "user/pic.bin", payload)
+                ) as r:
+                    assert r.status == 204, await r.text()
+                # policy violated: key outside the prefix
+                async with sess.post(
+                    endpoint + "/forms", data=mk_form(policy, "other/pic.bin", b"x")
+                ) as r:
+                    assert r.status == 403
+                # bad signature
+                async with sess.post(
+                    endpoint + "/forms",
+                    data=mk_form(policy, "user/x.bin", b"x", sign_with="00" * 32),
+                ) as r:
+                    assert r.status == 403
+                # over the content-length-range
+                async with sess.post(
+                    endpoint + "/forms",
+                    data=mk_form(policy, "user/big.bin", os.urandom(150_000)),
+                ) as r:
+                    assert r.status == 400
+            got = await client.get_object("forms", "user/pic.bin")
+            assert got == payload
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
