@@ -3,8 +3,8 @@
 Reference src/api/common/signature/payload.rs (canonical request, scope,
 key derivation) — implemented from the SigV4 spec, both header-based
 `Authorization` and presigned query (`X-Amz-Signature`) forms.  Payload
-policy: `x-amz-content-sha256` of UNSIGNED-PAYLOAD, or the hex sha256 of
-the body, which is checked; streaming chunked signatures land with M6.
+policy: `x-amz-content-sha256` of UNSIGNED-PAYLOAD, the hex sha256 of the
+body (checked), or the aws-chunked streaming forms (see streaming.py).
 
 The same functions sign outgoing requests for the in-repo client
 (no boto3 in this environment) and the integration tests.
@@ -102,9 +102,12 @@ def compute_signature(
 class AuthContext:
     """Parsed+verified request authentication."""
 
-    def __init__(self, key_id: str, payload_hash: str | None):
+    def __init__(self, key_id: str, payload_hash: str | None, streaming=None):
         self.key_id = key_id
         self.content_sha256 = payload_hash  # None = unsigned
+        # "signed" | "unsigned-trailer" framing context (StreamingContext
+        # or the string "unsigned"); None = plain body
+        self.streaming = streaming
 
 
 def parse_authorization(auth: str) -> tuple[str, str, str, str, list[str], str]:
@@ -169,6 +172,21 @@ async def verify_request(request, get_secret, region: str) -> AuthContext:
     )
     if not hmac.compare_digest(expected, signature):
         raise AuthError("request signature does not match")
+    from .streaming import (
+        STREAMING_SIGNED,
+        STREAMING_UNSIGNED_TRAILER,
+        StreamingContext,
+    )
+
+    if payload_hash == STREAMING_SIGNED:
+        scope = f"{date}/{req_region}/{service}/aws4_request"
+        sctx = StreamingContext(
+            signing_key(secret, date, req_region, service),
+            timestamp, scope, expected,
+        )
+        return AuthContext(key_id, None, streaming=sctx)
+    if payload_hash == STREAMING_UNSIGNED_TRAILER:
+        return AuthContext(key_id, None, streaming="unsigned")
     return AuthContext(key_id, None if payload_hash == UNSIGNED else payload_hash)
 
 
@@ -235,7 +253,7 @@ def sign_request_headers(
     date = now.strftime("%Y%m%d")
     h = {k.lower(): v for k, v in headers.items()}
     h["x-amz-date"] = timestamp
-    payload_hash = hashlib.sha256(body).hexdigest()
+    payload_hash = h.get("x-amz-content-sha256") or hashlib.sha256(body).hexdigest()
     h["x-amz-content-sha256"] = payload_hash
     signed_headers = sorted(set(list(h.keys()) + ["host"]))
     sig = compute_signature(

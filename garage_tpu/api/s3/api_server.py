@@ -239,6 +239,14 @@ class S3ApiServer:
                 return web.Response(status=204)
             raise BadRequest(f"unsupported bucket method {method}")
 
+        # aws-chunked streaming bodies decode (and verify per-chunk
+        # signatures) transparently before the put pipelines see them
+        if ctx.streaming is not None and method == "PUT" and key:
+            from ..common.streaming import ChunkedDecoder
+
+            sctx = None if ctx.streaming == "unsigned" else ctx.streaming
+            request = _StreamingRequestProxy(request, ChunkedDecoder(request.content, sctx))
+
         # object-level ops
         if method == "POST":
             _require(perm.allow_write)
@@ -345,3 +353,14 @@ class S3ApiServer:
 def _require(cond: bool) -> None:
     if not cond:
         raise Forbidden("access denied for this operation")
+
+
+class _StreamingRequestProxy:
+    """A request whose body reads through the aws-chunked decoder."""
+
+    def __init__(self, request, decoder):
+        self._request = request
+        self.content = decoder
+
+    def __getattr__(self, name):
+        return getattr(self._request, name)

@@ -153,6 +153,52 @@ class S3Client:
             "next_token": root.findtext("s3:NextContinuationToken", namespaces=ns),
         }
 
+    async def put_object_streaming(
+        self, bucket: str, key: str, body: bytes, chunk_size: int = 65536
+    ) -> str:
+        """PUT with aws-chunked signed streaming (per-chunk signatures)."""
+        from datetime import datetime, timezone
+
+        from ..common.signature import compute_signature, signing_key
+        from ..common.streaming import (
+            STREAMING_SIGNED,
+            StreamingContext,
+            encode_chunked,
+        )
+
+        now = datetime.now(timezone.utc)
+        timestamp = now.strftime("%Y%m%dT%H%M%SZ")
+        date = now.strftime("%Y%m%d")
+        path = f"/{bucket}/{key}"
+        h = {
+            "host": self.host,
+            "x-amz-date": timestamp,
+            "x-amz-content-sha256": STREAMING_SIGNED,
+            "content-encoding": "aws-chunked",
+            "x-amz-decoded-content-length": str(len(body)),
+        }
+        signed_headers = sorted(h.keys())
+        seed = compute_signature(
+            self.secret, "PUT", path, [], h, signed_headers,
+            STREAMING_SIGNED, timestamp, date, self.region,
+        )
+        scope = f"{date}/{self.region}/s3/aws4_request"
+        sctx = StreamingContext(
+            signing_key(self.secret, date, self.region), timestamp, scope, seed
+        )
+        h["authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.key_id}/{scope}, "
+            f"SignedHeaders={';'.join(signed_headers)}, Signature={seed}"
+        )
+        wire = encode_chunked(body, sctx, chunk_size)
+        url = self.endpoint + urllib.parse.quote(path)
+        async with self._sess().put(
+            url, data=wire, headers=h, skip_auto_headers=["Content-Type"]
+        ) as resp:
+            data = await resp.read()
+            self._check(resp.status, data)
+            return resp.headers.get("ETag", "").strip('"')
+
     # --- multipart ------------------------------------------------------------
 
     async def create_multipart_upload(self, bucket: str, key: str) -> str:
