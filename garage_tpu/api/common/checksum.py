@@ -66,16 +66,30 @@ def _hasher(algo: str):
 class ChecksumRequest:
     """One declared upload checksum: algorithm + expected base64 value."""
 
-    def __init__(self, algo: str, expected_b64: str):
+    def __init__(self, algo: str, expected_b64: str | None):
         self.algo = algo
-        self.expected_b64 = expected_b64
+        self.expected_b64 = expected_b64  # None until the trailer arrives
         self.hasher = _hasher(algo)
+
+    def resolve_trailer(self, trailers: dict[str, str]) -> None:
+        if self.expected_b64 is None:
+            self.expected_b64 = trailers.get(HEADER_PREFIX + self.algo, "").strip()
+            if not self.expected_b64:
+                raise BadRequest(
+                    f"declared trailer checksum {self.algo} missing from trailers"
+                )
 
     @classmethod
     def from_headers(cls, headers) -> "ChecksumRequest | None":
         h = {k.lower(): v for k, v in headers.items()}
         found = [a for a in ALGOS if HEADER_PREFIX + a in h]
         if not found:
+            # a trailer declaration means the value arrives AFTER the body
+            trailer = h.get("x-amz-trailer", "").strip().lower()
+            if trailer.startswith(HEADER_PREFIX):
+                algo = trailer[len(HEADER_PREFIX):]
+                if algo in ALGOS:
+                    return cls(algo, None)
             return None
         if len(found) > 1:
             raise BadRequest("multiple checksum headers supplied")

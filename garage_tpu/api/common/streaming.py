@@ -14,8 +14,8 @@ the request's seed (Authorization) signature:
 
 so a long upload is authenticated incrementally without buffering it.
 `STREAMING-UNSIGNED-PAYLOAD-TRAILER` uses the same framing without
-per-chunk signatures (trailing checksums are verified by the checksum
-layer over the decoded stream).
+per-chunk signatures; trailers (e.g. `x-amz-checksum-*`) are captured by
+the decoder and verified by the put path over the decoded stream.
 """
 
 from __future__ import annotations
@@ -29,6 +29,7 @@ STREAMING_SIGNED = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 STREAMING_UNSIGNED_TRAILER = "STREAMING-UNSIGNED-PAYLOAD-TRAILER"
 EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
 MAX_CHUNK_HEADER = 8 * 1024
+MAX_CHUNK_SIZE = 16 * 1024 * 1024  # declared chunk cap: bound buffering
 
 
 class StreamingContext:
@@ -65,6 +66,7 @@ class ChunkedDecoder:
         self.buf = b""
         self.pending = b""  # decoded-but-undelivered payload
         self.eof = False
+        self.trailers: dict[str, str] = {}  # e.g. trailing checksums
 
     async def _fill(self, n: int) -> None:
         while len(self.buf) < n:
@@ -91,6 +93,10 @@ class ChunkedDecoder:
             size = int(size_hex, 16)
         except ValueError as e:
             raise BadRequest(f"bad chunk size {size_hex!r}") from e
+        if size > MAX_CHUNK_SIZE:
+            raise BadRequest(
+                f"chunk of {size} bytes exceeds the {MAX_CHUNK_SIZE} limit"
+            )
         sig = None
         if ext.startswith(b"chunk-signature="):
             sig = ext[len(b"chunk-signature="):].decode()
@@ -104,7 +110,7 @@ class ChunkedDecoder:
                 raise AuthError("chunk signature does not match")
             self.prev_sig = expected
         if size == 0:
-            # trailers (if any) follow; consume until the blank line or EOF
+            # capture trailers (e.g. x-amz-checksum-*) until blank line/EOF
             while True:
                 try:
                     line = await self._read_line()
@@ -112,6 +118,9 @@ class ChunkedDecoder:
                     break
                 if line == b"":
                     break
+                name, sep, value = line.decode(errors="replace").partition(":")
+                if sep:
+                    self.trailers[name.strip().lower()] = value.strip()
             return None
         # trailing CRLF after the data
         await self._fill(2)

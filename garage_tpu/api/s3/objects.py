@@ -177,6 +177,13 @@ async def handle_put_object(
         for h_orig in [next((k for k in request.headers if k.lower() == h), None)]
         if h_orig
     ]
+    # aws-chunked is transport framing, not object metadata: the stored
+    # body is the decoded plaintext
+    headers = [
+        [h, ",".join(t for t in v.split(",") if t.strip() != "aws-chunked")]
+        for h, v in headers
+        if not (h == "content-encoding" and v.strip() == "aws-chunked")
+    ]
     body = request.content
     block_size = garage.config.block_size
 
@@ -190,6 +197,8 @@ async def handle_put_object(
         meta = {"size": len(first), "etag": etag, "headers": headers}
         if cks is not None:
             cks.update(first)
+            if cks.expected_b64 is None:
+                cks.resolve_trailer(getattr(body, "trailers", {}) or {})
             meta["cks"] = cks.verify()
         stored = first
         if enc is not None:
@@ -221,6 +230,8 @@ async def handle_put_object(
             transform=enc.encrypt_block if enc else None, extra_hash=cks,
         )
         _check_sha256(ctx, sha)
+        if cks is not None and cks.expected_b64 is None:
+            cks.resolve_trailer(getattr(body, "trailers", {}) or {})
         await check_quotas(garage, bucket_id, key, total)
 
         etag = md5_hex

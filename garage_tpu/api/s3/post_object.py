@@ -73,9 +73,10 @@ async def handle_post_object(server, bucket_name: str, request) -> web.Response:
 
     # --- check policy conditions ----------------------------------------------
     try:
-        exp = datetime.strptime(
-            policy["expiration"].split(".")[0], "%Y-%m-%dT%H:%M:%S"
-        ).replace(tzinfo=timezone.utc)
+        exp_str = policy["expiration"].rstrip("Z").split(".")[0]
+        exp = datetime.strptime(exp_str, "%Y-%m-%dT%H:%M:%S").replace(
+            tzinfo=timezone.utc
+        )
     except (KeyError, ValueError) as e:
         raise BadRequest(f"bad policy expiration: {e}") from e
     if datetime.now(timezone.utc) > exp:
@@ -84,28 +85,32 @@ async def handle_post_object(server, bucket_name: str, request) -> web.Response:
     object_key = fields.get("key", "")
     if "${filename}" in object_key:
         object_key = object_key.replace("${filename}", file_part.filename or "file")
+    def field_value(name: str) -> str:
+        if name == "bucket":
+            return bucket_name
+        if name == "key":
+            return object_key
+        return fields.get(name, "")
+
     length_range = None
     for cond in policy.get("conditions", []):
         if isinstance(cond, dict):
             for k, v in cond.items():
-                k = k.lower()
-                if k == "bucket" and v != bucket_name:
-                    raise Forbidden("policy bucket mismatch")
-                if k == "key" and v != object_key:
-                    raise Forbidden("policy key mismatch")
+                if field_value(k.lower()) != v:
+                    raise Forbidden(f"policy condition failed for {k}")
         elif isinstance(cond, list) and len(cond) == 3:
             op, name, val = cond[0], str(cond[1]).lstrip("$").lower(), cond[2]
             if op == "eq":
-                if fields.get(name, "" if name != "bucket" else bucket_name) != val and not (
-                    name == "bucket" and val == bucket_name
-                ) and not (name == "key" and val == object_key):
+                if field_value(name) != val:
                     raise Forbidden(f"policy eq condition failed for {name}")
             elif op == "starts-with":
-                have = object_key if name == "key" else fields.get(name, "")
-                if not have.startswith(val):
+                if not field_value(name).startswith(val):
                     raise Forbidden(f"policy starts-with failed for {name}")
             elif op == "content-length-range":
-                length_range = (int(cond[1]), int(cond[2]))
+                try:
+                    length_range = (int(cond[1]), int(cond[2]))
+                except (TypeError, ValueError) as e:
+                    raise BadRequest(f"bad content-length-range: {e}") from e
     if not object_key:
         raise BadRequest("no key for POST upload")
 
@@ -146,12 +151,19 @@ async def handle_post_object(server, bucket_name: str, request) -> web.Response:
 
     resp = await handle_put_object(server.garage, bucket_id, object_key, _FakeRequest())
     if length_range and body.total < length_range[0]:
+        # the object was already stored: roll it back before failing
+        from .objects import handle_delete_object
+
+        await handle_delete_object(server.garage, bucket_id, object_key)
         raise ApiError(
             "upload below policy content-length-range",
             code="EntityTooSmall",
             status=400,
         )
-    status = int(fields.get("success_action_status", "204"))
+    try:
+        status = int(fields.get("success_action_status", "204"))
+    except ValueError:
+        status = 204
     if status not in (200, 201, 204):
         status = 204
     if status == 201:

@@ -850,3 +850,70 @@ def test_streaming_signature_upload(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_streaming_trailer_checksum(tmp_path):
+    """STREAMING-UNSIGNED-PAYLOAD-TRAILER: trailing checksum captured and
+    verified over the decoded stream."""
+
+    async def main():
+        import base64
+        import zlib
+
+        import aiohttp
+
+        from garage_tpu.api.common.signature import sign_request_headers
+        from garage_tpu.api.common.streaming import STREAMING_UNSIGNED_TRAILER
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("trailers")
+            body = os.urandom(10_000)
+            crc_b64 = base64.b64encode(
+                (zlib.crc32(body) & 0xFFFFFFFF).to_bytes(4, "big")
+            ).decode()
+
+            def wire(trailer_value):
+                out = []
+                for i in range(0, len(body), 4096):
+                    c = body[i : i + 4096]
+                    out.append(f"{len(c):x}\r\n".encode() + c + b"\r\n")
+                out.append(b"0\r\n")
+                out.append(f"x-amz-checksum-crc32: {trailer_value}\r\n\r\n".encode())
+                return b"".join(out)
+
+            async def send(path, trailer_value):
+                headers = {
+                    "host": client.host,
+                    "x-amz-content-sha256": STREAMING_UNSIGNED_TRAILER,
+                    "content-encoding": "aws-chunked",
+                    "x-amz-trailer": "x-amz-checksum-crc32",
+                }
+                signed = sign_request_headers(
+                    "PUT", path, [], headers, b"", client.key_id, client.secret,
+                    "garage",
+                )
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.put(
+                        endpoint + path, data=wire(trailer_value), headers=signed
+                    ) as resp:
+                        return resp.status, await resp.text()
+
+            st, text = await send("/trailers/good.bin", crc_b64)
+            assert st == 200, text
+            got = await client.get_object("trailers", "good.bin")
+            assert got == body
+            # the verified checksum is persisted and served
+            h = await client.head_object("trailers", "good.bin")
+            assert h["x-amz-checksum-crc32"] == crc_b64
+            # object metadata does NOT replay aws-chunked transport framing
+            assert h.get("Content-Encoding") != "aws-chunked"
+
+            # wrong trailer value -> 400 BadDigest
+            st, text = await send("/trailers/bad.bin", "AAAAAA==")
+            assert st == 400 and "BadDigest" in text
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
