@@ -37,6 +37,31 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+
+    # Watchdog: the tunneled TPU platform can wedge (ops hang forever).
+    # Probe it from a daemon thread; if the probe doesn't finish in time,
+    # fall back to the CPU backend so the driver always gets a JSON line.
+    import threading
+
+    probe_ok = threading.Event()
+
+    def _probe():
+        try:
+            import jax.numpy as _jnp
+
+            np.asarray(_jnp.arange(4.0) * 2)
+            probe_ok.set()
+        except Exception:  # noqa: BLE001 — fall through to CPU
+            pass
+
+    backend = None
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    if not probe_ok.wait(timeout=180.0):
+        print("# default backend unresponsive; using cpu", file=sys.stderr)
+        backend = "cpu"
+        jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
     import jax.numpy as jnp
 
     from garage_tpu.models.pipeline import ScrubRepairPipeline
@@ -48,8 +73,8 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, (args.batch, k, shard_bytes), dtype=np.uint8)
-    data_dev = jax.device_put(jnp.asarray(data))
-    dev = jax.devices()[0]
+    dev = jax.devices(backend)[0] if backend else jax.devices()[0]
+    data_dev = jax.device_put(jnp.asarray(data), dev)
     if args.verbose:
         print(f"# backend={dev.platform} device={dev}", file=sys.stderr)
 
