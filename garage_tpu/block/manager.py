@@ -46,19 +46,54 @@ logger = logging.getLogger("garage.block")
 
 INLINE_THRESHOLD = 3072  # smaller objects inline in the object table
 
-# EC piece files carry the original block length (needed to strip the
-# codec's stripe padding at decode time): b"GTP1" + u64 len + piece bytes
-PIECE_MAGIC = b"GTP1"
+# EC piece files carry the original block length (to strip the codec's
+# stripe padding at decode time) and the BLAKE3 of the piece (per-piece
+# integrity for scrub — the block hash only covers the decoded plaintext):
+#   b"GTP2" + u64 block_len + 32B blake3(piece) + piece
+# (v1 "GTP1" files without the hash are still readable.)
+PIECE_MAGIC_V1 = b"GTP1"
+PIECE_MAGIC = b"GTP2"
+
+
+def piece_hash(piece: bytes) -> bytes:
+    from .. import _native
+
+    h = _native.blake3(piece)
+    if h is not None:
+        return h
+    from ..ops.blake3_ref import blake3 as _py_blake3
+
+    return _py_blake3(piece)
 
 
 def wrap_piece(block_len: int, piece: bytes) -> bytes:
-    return PIECE_MAGIC + block_len.to_bytes(8, "big") + piece
+    return (
+        PIECE_MAGIC + block_len.to_bytes(8, "big") + piece_hash(piece) + piece
+    )
 
 
-def unwrap_piece(stored: bytes) -> tuple[int, bytes]:
+def unwrap_piece(stored: bytes, verify: bool = True) -> tuple[int, bytes]:
+    if stored[:4] == PIECE_MAGIC:
+        blen = int.from_bytes(stored[4:12], "big")
+        want = stored[12:44]
+        piece = stored[44:]
+        if verify and piece_hash(piece) != want:
+            raise Error("EC piece integrity hash mismatch")
+        return blen, piece
+    if stored[:4] == PIECE_MAGIC_V1:
+        return int.from_bytes(stored[4:12], "big"), stored[12:]
+    raise Error("not an EC piece file")
+
+
+def stored_piece_parts(stored: bytes) -> tuple[int, bytes, bytes] | None:
+    """(block_len, expected_hash, piece) for v2 files; None for v1."""
     if stored[:4] != PIECE_MAGIC:
-        raise Error("not an EC piece file")
-    return int.from_bytes(stored[4:12], "big"), stored[12:]
+        return None
+    return (
+        int.from_bytes(stored[4:12], "big"),
+        stored[12:44],
+        stored[44:],
+    )
 
 
 class BlockManager:
