@@ -203,17 +203,14 @@ class AdminApiServer:
 
         if path == "/v1/bucket":
             if request.method == "GET":
-                if "id" in request.query:
-                    b = await g.helper.get_bucket(bytes.fromhex(request.query["id"]))
-                    p = b.params()
-                    return web.json_response(
-                        {
-                            "id": hex_of(b.id),
-                            "globalAliases": [n for n, v in p.aliases.items() if v],
-                            "websiteConfig": p.website.get(),
-                            "quotas": p.quotas.get(),
-                        }
-                    )
+                if "id" in request.query or "globalAlias" in request.query:
+                    if "id" in request.query:
+                        bid = bytes.fromhex(request.query["id"])
+                    else:
+                        bid = await g.helper.resolve_bucket(
+                            request.query["globalAlias"]
+                        )
+                    return web.json_response(await self._bucket_info(bid))
                 out = []
                 for b in await g.helper.list_buckets():
                     out.append(
@@ -228,10 +225,69 @@ class AdminApiServer:
             if request.method == "POST":
                 body = await request.json()
                 bid = await g.helper.create_bucket(body["globalAlias"])
-                return web.json_response({"id": hex_of(bid)})
+                if body.get("localAlias"):
+                    la = body["localAlias"]
+                    await g.helper.set_local_alias(
+                        bid, la["accessKeyId"], la["alias"]
+                    )
+                    if la.get("allow"):
+                        perms = la["allow"]
+                        await g.helper.set_bucket_key_permissions(
+                            bid, la["accessKeyId"],
+                            perms.get("read", False),
+                            perms.get("write", False),
+                            perms.get("owner", False),
+                        )
+                return web.json_response(await self._bucket_info(bid))
+            if request.method == "PUT":
+                # UpdateBucket (reference api/admin/bucket.rs
+                # handle_update_bucket): website access + quotas
+                bid = bytes.fromhex(request.query["id"])
+                body = await request.json()
+                b = await g.helper.get_bucket(bid)
+                p = b.params()
+                if "websiteAccess" in body:
+                    wa = body["websiteAccess"]
+                    if wa.get("enabled"):
+                        p.website.update(
+                            {
+                                "index_document": wa.get("indexDocument", "index.html"),
+                                "error_document": wa.get("errorDocument"),
+                            }
+                        )
+                    else:
+                        p.website.update(None)
+                if "quotas" in body:
+                    q = body["quotas"]
+                    p.quotas.update(
+                        {
+                            "max_size": q.get("maxSize"),
+                            "max_objects": q.get("maxObjects"),
+                        }
+                    )
+                await g.bucket_table.insert(b)
+                return web.json_response(await self._bucket_info(bid))
             if request.method == "DELETE":
                 await g.helper.delete_bucket(bytes.fromhex(request.query["id"]))
                 return web.json_response({"ok": True})
+
+        if path in (
+            "/v1/bucket/alias/global", "/v1/bucket/alias/local"
+        ) and request.method in ("PUT", "DELETE"):
+            q = request.query
+            bid = bytes.fromhex(q["id"])
+            alias = q["alias"]
+            if path.endswith("global"):
+                if request.method == "PUT":
+                    await g.helper.set_global_alias(bid, alias)
+                else:
+                    await g.helper.unset_global_alias(bid, alias)
+            else:
+                if request.method == "PUT":
+                    await g.helper.set_local_alias(bid, q["accessKeyId"], alias)
+                else:
+                    await g.helper.unset_local_alias(bid, q["accessKeyId"], alias)
+            return web.json_response(await self._bucket_info(bid))
 
         if path in ("/v1/bucket/allow", "/v1/bucket/deny") and request.method == "POST":
             body = await request.json()
@@ -248,16 +304,26 @@ class AdminApiServer:
 
         if path == "/v1/key":
             if request.method == "GET":
-                if "id" in request.query:
-                    k = await g.helper.get_key(request.query["id"])
+                if "id" in request.query or "search" in request.query:
+                    if "id" in request.query:
+                        k = await g.helper.get_key(request.query["id"])
+                    else:
+                        pat = request.query["search"]
+                        matches = [
+                            k
+                            for k in await g.helper.list_keys()
+                            if k.key_id.startswith(pat)
+                            or pat.lower() in (k.params().name.get() or "").lower()
+                        ]
+                        if len(matches) != 1:
+                            return web.json_response(
+                                {"error": f"{len(matches)} keys match"}, status=400
+                            )
+                        k = matches[0]
                     return web.json_response(
-                        {
-                            "accessKeyId": k.key_id,
-                            "name": k.params().name.get(),
-                            "secretAccessKey": k.secret()
-                            if request.query.get("showSecretKey") == "true"
-                            else None,
-                        }
+                        self._key_info(
+                            k, request.query.get("showSecretKey") == "true"
+                        )
                     )
                 return web.json_response(
                     [
@@ -267,12 +333,95 @@ class AdminApiServer:
                 )
             if request.method == "POST":
                 body = await request.json() if request.can_read_body else {}
-                k = await g.helper.create_key(body.get("name", ""))
-                return web.json_response(
-                    {"accessKeyId": k.key_id, "secretAccessKey": k.secret()}
-                )
+                if "id" in request.query:
+                    # UpdateKey (reference api/admin/key.rs handle_update_key)
+                    k = await g.helper.update_key(
+                        request.query["id"],
+                        name=body.get("name"),
+                        allow_create_bucket=(body.get("allow") or {}).get(
+                            "createBucket"
+                        )
+                        if "allow" in body
+                        else (
+                            False
+                            if (body.get("deny") or {}).get("createBucket")
+                            else None
+                        ),
+                    )
+                else:
+                    k = await g.helper.create_key(body.get("name", ""))
+                return web.json_response(self._key_info(k, True))
             if request.method == "DELETE":
                 await g.helper.delete_key(request.query["id"])
                 return web.json_response({"ok": True})
 
+        if path == "/v1/key/import" and request.method == "POST":
+            body = await request.json()
+            k = await g.helper.import_key(
+                body["accessKeyId"], body["secretAccessKey"], body.get("name", "")
+            )
+            return web.json_response(self._key_info(k, False))
+
         return web.json_response({"error": "no such endpoint"}, status=404)
+
+    async def _bucket_info(self, bid: bytes) -> dict:
+        """Full GetBucketInfo shape (reference api/admin/bucket.rs):
+        aliases, per-key permissions, website/quotas, usage counters."""
+        g = self.garage
+        b = await g.helper.get_bucket(bid)
+        p = b.params()
+        keys = []
+        for k in await g.helper.list_keys():
+            kp = k.params()
+            perm = k.bucket_permissions(bid)
+            local = [
+                n
+                for n, v in kp.local_aliases.items()
+                if v is not None and bytes(v) == bid
+            ]
+            if perm.allow_read or perm.allow_write or perm.allow_owner or local:
+                keys.append(
+                    {
+                        "accessKeyId": k.key_id,
+                        "name": kp.name.get(),
+                        "permissions": {
+                            "read": perm.allow_read,
+                            "write": perm.allow_write,
+                            "owner": perm.allow_owner,
+                        },
+                        "bucketLocalAliases": local,
+                    }
+                )
+        counts = await g.object_counter.get_values(bid)
+        website = p.website.get()
+        quotas = p.quotas.get() or {}
+        return {
+            "id": hex_of(bid),
+            "globalAliases": [n for n, v in p.aliases.items() if v],
+            "websiteAccess": website is not None,
+            "websiteConfig": website,
+            "keys": keys,
+            "objects": counts.get("objects", 0),
+            "bytes": counts.get("bytes", 0),
+            "unfinishedUploads": counts.get("unfinished_uploads", 0),
+            "quotas": {
+                "maxSize": quotas.get("max_size"),
+                "maxObjects": quotas.get("max_objects"),
+            },
+        }
+
+    def _key_info(self, k, show_secret: bool) -> dict:
+        kp = k.params()
+        return {
+            "accessKeyId": k.key_id,
+            "name": kp.name.get(),
+            "secretAccessKey": k.secret() if show_secret else None,
+            "permissions": {"createBucket": bool(kp.allow_create_bucket.get())},
+            "buckets": [
+                {
+                    "id": hex_of(bytes(b)),
+                    "permissions": perm,
+                }
+                for b, perm in kp.authorized_buckets.items()
+            ],
+        }

@@ -203,12 +203,21 @@ async def _verify_presigned(request, headers, query_items, path, get_secret, reg
         raise AuthError(f"malformed presigned query: {e}") from e
     if req_region != region:
         raise AuthError(f"wrong region {req_region!r}")
+    # mirror the header path's checks: expires bounds (AWS max 7 days),
+    # scope-date consistency, and no far-future timestamps
+    if not 1 <= expires <= 604800:
+        raise AuthError("X-Amz-Expires must be between 1 and 604800 seconds")
+    if timestamp[:8] != date:
+        raise AuthError("X-Amz-Date does not match credential scope date")
     try:
         t0 = datetime.strptime(timestamp, "%Y%m%dT%H%M%SZ").replace(
             tzinfo=timezone.utc
         )
-        if (datetime.now(timezone.utc) - t0).total_seconds() > expires:
+        age = (datetime.now(timezone.utc) - t0).total_seconds()
+        if age > expires:
             raise AuthError("presigned URL expired")
+        if age < -15 * 60:
+            raise AuthError("X-Amz-Date is in the future")
     except ValueError as e:
         raise AuthError(f"bad X-Amz-Date: {e}") from e
     secret = await get_secret(key_id)

@@ -109,6 +109,9 @@ class K2VApiServer:
             raise BadRequest(f"unsupported {m} on bucket")
 
         if sk is None:
+            if m == "POST" and "poll_range" in q:
+                _req(perm.allow_read)
+                return await self._poll_range(bucket_id, pk, request)
             raise BadRequest("missing sort key")
 
         if m == "GET":
@@ -164,6 +167,40 @@ class K2VApiServer:
         return web.json_response(
             [base64.b64encode(v).decode() for v in values],
             headers={TOKEN_HEADER: item.causal_context().serialize()},
+        )
+
+    async def _poll_range(self, bucket_id, pk, request) -> web.Response:
+        """PollRange (reference src/api/k2v/batch.rs:255): long-poll a
+        whole sort-key range for changes the seenMarker hasn't covered."""
+        body = json.loads(await request.read() or b"{}")
+        timeout = min(max(float(body.get("timeout", 300)), 1.0), 600.0)
+        res = await self.garage.k2v_rpc.poll_range(
+            bucket_id,
+            pk,
+            body.get("start"),
+            body.get("end"),
+            body.get("prefix"),
+            body.get("seenMarker"),
+            timeout,
+        )
+        if res is None:
+            return web.Response(status=304)
+        items, seen_marker = res
+        return web.json_response(
+            {
+                "items": [
+                    {
+                        "sk": sk,
+                        "ct": item.causal_context().serialize(),
+                        "v": [
+                            base64.b64encode(v).decode() if v is not None else None
+                            for v in item.values()
+                        ],
+                    }
+                    for sk, item in items.items()
+                ],
+                "seenMarker": seen_marker,
+            }
         )
 
     # --- index + batches ------------------------------------------------------

@@ -90,13 +90,15 @@ class S3ApiServer:
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         from ...utils.metrics import registry
+        from ...utils.tracing import span
 
         registry.incr("api_s3_request_counter", (("method", request.method),))
         try:
-            with registry.timer(
-                "api_s3_request_duration", (("method", request.method),)
-            ):
-                return await self._handle(request)
+            with span("api:s3", method=request.method, path=request.path):
+                with registry.timer(
+                    "api_s3_request_duration", (("method", request.method),)
+                ):
+                    return await self._handle(request)
         except ApiError as e:
             if e.status == 304:
                 return web.Response(status=304)
@@ -262,6 +264,11 @@ class S3ApiServer:
         if method == "PUT":
             _require(perm.allow_write)
             if "partNumber" in q:
+                if "x-amz-copy-source" in request.headers:
+                    return await mp.handle_upload_part_copy(
+                        self.garage, self.garage.helper, api_key,
+                        bucket_id, key, request, ctx=ctx,
+                    )
                 return await mp.handle_upload_part(
                     self.garage, bucket_id, key, request, ctx=ctx
                 )

@@ -101,17 +101,65 @@ class S3Client:
         return h.get("ETag", "").strip('"')
 
     async def get_object(
-        self, bucket: str, key: str, range_: str | None = None
+        self,
+        bucket: str,
+        key: str,
+        range_: str | None = None,
+        part_number: int | None = None,
+        headers: dict[str, str] | None = None,
     ) -> bytes:
-        headers = {"range": range_} if range_ else {}
-        st, _h, data = await self._req("GET", f"/{bucket}/{key}", headers=headers)
+        h = dict(headers or {})
+        if range_:
+            h["range"] = range_
+        q = [("partNumber", str(part_number))] if part_number is not None else []
+        st, _h, data = await self._req("GET", f"/{bucket}/{key}", query=q, headers=h)
         self._check(st, data)
         return data
 
-    async def head_object(self, bucket: str, key: str) -> dict:
-        st, h, data = await self._req("HEAD", f"/{bucket}/{key}")
+    async def get_object_full(
+        self,
+        bucket: str,
+        key: str,
+        part_number: int | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> tuple[int, dict, bytes]:
+        """Raw (status, headers, body) — for conditional/part-read tests."""
+        q = [("partNumber", str(part_number))] if part_number is not None else []
+        return await self._req("GET", f"/{bucket}/{key}", query=q, headers=headers)
+
+    async def head_object(
+        self, bucket: str, key: str, part_number: int | None = None
+    ) -> dict:
+        q = [("partNumber", str(part_number))] if part_number is not None else []
+        st, h, data = await self._req("HEAD", f"/{bucket}/{key}", query=q)
         self._check(st, data)
         return h
+
+    async def upload_part_copy(
+        self,
+        bucket: str,
+        key: str,
+        upload_id: str,
+        part_number: int,
+        src_bucket: str,
+        src_key: str,
+        src_range: str | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> str:
+        h = dict(headers or {})
+        h["x-amz-copy-source"] = f"/{src_bucket}/{src_key}"
+        if src_range:
+            h["x-amz-copy-source-range"] = src_range
+        st, _h, data = await self._req(
+            "PUT",
+            f"/{bucket}/{key}",
+            query=[("partNumber", str(part_number)), ("uploadId", upload_id)],
+            headers=h,
+        )
+        self._check(st, data)
+        ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+        root = ET.fromstring(data.decode())
+        return (root.findtext("s3:ETag", namespaces=ns) or "").strip('"')
 
     async def delete_object(self, bucket: str, key: str) -> None:
         st, _h, data = await self._req("DELETE", f"/{bucket}/{key}")

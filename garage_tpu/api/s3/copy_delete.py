@@ -23,7 +23,12 @@ from .objects import handle_delete_object
 from .xml_util import http_iso as _http_iso, xml_doc
 
 
-async def handle_copy_object(garage, helper, api_key, dest_bucket_id, dest_key, request):
+async def resolve_copy_source(garage, helper, api_key, request):
+    """Resolve x-amz-copy-source to its newest visible version, enforcing
+    read permission and the x-amz-copy-source-if-* preconditions
+    (reference copy.rs source resolution, shared with UploadPartCopy)."""
+    from .objects import Preconditions
+
     src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
     src = src.lstrip("/")
     if "/" not in src:
@@ -40,6 +45,12 @@ async def handle_copy_object(garage, helper, api_key, dest_bucket_id, dest_key, 
     sv = obj.last_visible() if obj else None
     if sv is None:
         raise NoSuchKey("copy source not found")
+    Preconditions.parse_copy_source(request).check_copy_source(sv)
+    return sv
+
+
+async def handle_copy_object(garage, helper, api_key, dest_bucket_id, dest_key, request):
+    sv = await resolve_copy_source(garage, helper, api_key, request)
     meta = dict(sv.data.get("meta", {}))
     ts = now_msec()
     new_uuid = gen_uuid()

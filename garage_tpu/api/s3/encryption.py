@@ -24,6 +24,7 @@ from ..common.error import ApiError, BadRequest
 ALG_HEADER = "x-amz-server-side-encryption-customer-algorithm"
 KEY_HEADER = "x-amz-server-side-encryption-customer-key"
 MD5_HEADER = "x-amz-server-side-encryption-customer-key-md5"
+COPY_PREFIX = "x-amz-copy-source-"  # UploadPartCopy names the source key with these
 
 NONCE_LEN = 12
 TAG_LEN = 16
@@ -39,25 +40,31 @@ class EncryptionParams:
         self._aead = AESGCM(key)
 
     @classmethod
-    def from_headers(cls, headers) -> "EncryptionParams | None":
+    def from_headers(cls, headers, prefix: str = "") -> "EncryptionParams | None":
         h = {k.lower(): v for k, v in headers.items()}
-        alg = h.get(ALG_HEADER)
+        alg = h.get(prefix + ALG_HEADER)
         if alg is None:
-            if KEY_HEADER in h or MD5_HEADER in h:
+            if prefix + KEY_HEADER in h or prefix + MD5_HEADER in h:
                 raise BadRequest("SSE-C key supplied without algorithm header")
             return None
         if alg != "AES256":
             raise BadRequest(f"unsupported SSE-C algorithm {alg!r}")
         try:
-            key = base64.b64decode(h.get(KEY_HEADER, ""))
+            key = base64.b64decode(h.get(prefix + KEY_HEADER, ""))
         except Exception as e:
             raise BadRequest(f"bad SSE-C key encoding: {e}") from e
         if len(key) != 32:
             raise BadRequest("SSE-C key must be 256 bits")
-        md5_b64 = h.get(MD5_HEADER, "")
+        md5_b64 = h.get(prefix + MD5_HEADER, "")
         if base64.b64encode(hashlib.md5(key).digest()).decode() != md5_b64:
             raise BadRequest("SSE-C key MD5 mismatch")
         return cls(key, md5_b64)
+
+    @classmethod
+    def from_copy_source_headers(cls, headers) -> "EncryptionParams | None":
+        """The x-amz-copy-source-…-customer-* key naming the SOURCE object
+        of an UploadPartCopy (reference encryption.rs)."""
+        return cls.from_headers(headers, prefix=COPY_PREFIX)
 
     # --- block sealing --------------------------------------------------------
 

@@ -74,11 +74,44 @@ async def _collect(
     return entries, sorted(prefixes), False, ""
 
 
+def uriencode(s: str, encode_slash: bool = False) -> str:
+    """S3 `encoding-type=url` key encoding: RFC 3986 unreserved characters
+    kept verbatim, '/' kept unless encode_slash (reference
+    src/api/common/encoding.rs uri_encode) — the SigV4 canonical encoding."""
+    from ..common.signature import _uri_encode
+
+    return _uri_encode(s, encode_slash=encode_slash)
+
+
+# Owner/Initiator are access-control concepts Garage doesn't model per
+# object; fixed placeholder identity (reference list.rs:25-26 does the same)
+OWNER_XML = ("Owner", [("ID", "garage-tpu-owner"), ("DisplayName", "garage-tpu")])
+
+
+def _maybe_enc(s: str, urlencode: bool) -> str:
+    return uriencode(s) if urlencode else s
+
+
+def _contents_xml(e: dict, urlencode: bool, with_owner: bool):
+    fields = [
+        ("Key", _maybe_enc(e["key"], urlencode)),
+        ("LastModified", _http_iso(e["ts"])),
+        ("ETag", f'"{e["etag"]}"'),
+        ("Size", e["size"]),
+        ("StorageClass", "STANDARD"),
+    ]
+    if with_owner:
+        fields.append(OWNER_XML)
+    return ("Contents", fields)
+
+
 async def handle_list_objects_v2(garage, bucket_id: bytes, bucket_name: str, request):
     q = request.query
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter", "")
     max_keys = min(int(q.get("max-keys", "1000")), 1000)
+    urlencode = q.get("encoding-type") == "url"
+    fetch_owner = q.get("fetch-owner") == "true"
     token = q.get("continuation-token")
     start_after = q.get("start-after", "")
     if token:
@@ -89,10 +122,14 @@ async def handle_list_objects_v2(garage, bucket_id: bytes, bucket_name: str, req
     )
     children = [
         ("Name", bucket_name),
-        ("Prefix", prefix),
+        ("Prefix", _maybe_enc(prefix, urlencode)),
         ("KeyCount", len(entries) + len(prefixes)),
         ("MaxKeys", max_keys),
-        ("Delimiter", delimiter) if delimiter else None,
+        ("Delimiter", _maybe_enc(delimiter, urlencode)) if delimiter else None,
+        ("EncodingType", "url") if urlencode else None,
+        (
+            "StartAfter", _maybe_enc(q.get("start-after", ""), urlencode)
+        ) if q.get("start-after") else None,
         ("IsTruncated", truncated),
     ]
     if truncated:
@@ -103,20 +140,9 @@ async def handle_list_objects_v2(garage, bucket_id: bytes, bucket_name: str, req
             )
         )
     for e in entries:
-        children.append(
-            (
-                "Contents",
-                [
-                    ("Key", e["key"]),
-                    ("LastModified", _http_iso(e["ts"])),
-                    ("ETag", f'"{e["etag"]}"'),
-                    ("Size", e["size"]),
-                    ("StorageClass", "STANDARD"),
-                ],
-            )
-        )
+        children.append(_contents_xml(e, urlencode, fetch_owner))
     for p in prefixes:
-        children.append(("CommonPrefixes", [("Prefix", p)]))
+        children.append(("CommonPrefixes", [("Prefix", _maybe_enc(p, urlencode))]))
     return web.Response(
         text=xml_doc("ListBucketResult", children),
         content_type="application/xml",
@@ -128,35 +154,27 @@ async def handle_list_objects_v1(garage, bucket_id: bytes, bucket_name: str, req
     prefix = q.get("prefix", "")
     delimiter = q.get("delimiter", "")
     max_keys = min(int(q.get("max-keys", "1000")), 1000)
+    urlencode = q.get("encoding-type") == "url"
     marker = q.get("marker", "")
     entries, prefixes, truncated, next_start = await _collect(
         garage, bucket_id, prefix, delimiter, marker, max_keys
     )
     children = [
         ("Name", bucket_name),
-        ("Prefix", prefix),
-        ("Marker", marker),
+        ("Prefix", _maybe_enc(prefix, urlencode)),
+        ("Marker", _maybe_enc(marker, urlencode)),
         ("MaxKeys", max_keys),
-        ("Delimiter", delimiter) if delimiter else None,
+        ("Delimiter", _maybe_enc(delimiter, urlencode)) if delimiter else None,
+        ("EncodingType", "url") if urlencode else None,
         ("IsTruncated", truncated),
     ]
     if truncated and next_start:
-        children.append(("NextMarker", next_start))
+        children.append(("NextMarker", _maybe_enc(next_start, urlencode)))
     for e in entries:
-        children.append(
-            (
-                "Contents",
-                [
-                    ("Key", e["key"]),
-                    ("LastModified", _http_iso(e["ts"])),
-                    ("ETag", f'"{e["etag"]}"'),
-                    ("Size", e["size"]),
-                    ("StorageClass", "STANDARD"),
-                ],
-            )
-        )
+        # V1 always reports the owner
+        children.append(_contents_xml(e, urlencode, with_owner=True))
     for p in prefixes:
-        children.append(("CommonPrefixes", [("Prefix", p)]))
+        children.append(("CommonPrefixes", [("Prefix", _maybe_enc(p, urlencode))]))
     return web.Response(
         text=xml_doc("ListBucketResult", children),
         content_type="application/xml",

@@ -109,6 +109,121 @@ async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
     return web.Response(status=200, headers={"ETag": f'"{etag}"'})
 
 
+class _GenBody:
+    """Adapts an async chunk generator to the .read(n) body interface the
+    stream_blocks pipeline consumes."""
+
+    def __init__(self, gen):
+        self._gen = gen
+        self._buf = b""
+
+    async def read(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            try:
+                self._buf += await self._gen.__anext__()
+            except StopAsyncIteration:
+                break
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+
+def _parse_copy_source_range(request, size: int) -> tuple[int, int]:
+    """x-amz-copy-source-range: "bytes=a-b" (both bounds inclusive and
+    required, unlike a GET Range)."""
+    hdr = request.headers.get("x-amz-copy-source-range")
+    if hdr is None:
+        return (0, size)
+    if not hdr.startswith("bytes="):
+        raise BadRequest(f"bad x-amz-copy-source-range {hdr!r}")
+    a_s, _, b_s = hdr[len("bytes="):].partition("-")
+    try:
+        a, b = int(a_s), int(b_s)
+    except ValueError as e:
+        raise BadRequest(f"bad x-amz-copy-source-range {hdr!r}") from e
+    if a > b or b >= size:
+        raise ApiError(
+            f"copy source range {hdr!r} outside object of size {size}",
+            code="InvalidRange",
+            status=416,
+        )
+    return (a, b + 1)
+
+
+async def handle_upload_part_copy(
+    garage, helper, api_key, bucket_id, key, request, ctx=None
+):
+    """UploadPartCopy (reference src/api/s3/copy.rs:353
+    handle_upload_part_copy): read the source object (decrypting SSE-C
+    with the x-amz-copy-source-…-customer-* key when present), re-chunk
+    the plaintext at this cluster's block size, and store it as a part of
+    the destination upload under the destination's own encryption — the
+    cross-encryption path re-seals every block."""
+    q = request.query
+    part_number = int(q.get("partNumber", "0"))
+    if not (1 <= part_number <= 10000):
+        raise BadRequest("partNumber must be in 1..10000")
+    mpu = await _get_mpu(garage, bucket_id, key, q.get("uploadId", ""))
+
+    from .copy_delete import resolve_copy_source
+    from .encryption import EncryptionParams, check_match
+    from .objects import plain_block_stream, stream_blocks
+
+    dst_enc = EncryptionParams.from_headers(request.headers)
+    check_match(mpu.enc, dst_enc)
+    sv = await resolve_copy_source(garage, helper, api_key, request)
+    src_meta = sv.data.get("meta", {})
+    src_enc = EncryptionParams.from_copy_source_headers(request.headers)
+    check_match(src_meta.get("enc"), src_enc)
+    size = src_meta.get("size", 0)
+    start, end = _parse_copy_source_range(request, size)
+
+    if sv.data.get("t") == "inline":
+        data = sv.data["bytes"]
+        if src_enc is not None:
+            data = src_enc.decrypt_block(data)
+
+        async def _one():
+            yield data[start:end]
+
+        body = _GenBody(_one())
+    else:
+        src_ver = await garage.version_table.get(bytes(sv.data["vid"]), b"")
+        if src_ver is None or src_ver.deleted.get():
+            raise NoSuchKey("copy source data missing")
+        body = _GenBody(
+            plain_block_stream(garage, src_ver.sorted_blocks(), start, end, src_enc)
+        )
+
+    vid = gen_uuid()
+    await garage.version_table.insert(Version(vid, bucket_id, key))
+    try:
+        md5_hex, _sha, total = await stream_blocks(
+            garage, vid, bucket_id, key, part_number,
+            body, garage.config.block_size,
+            transform=dst_enc.encrypt_block if dst_enc else None,
+        )
+    except BaseException:
+        await garage.version_table.insert(
+            Version.deleted_marker(vid, bucket_id, key)
+        )
+        raise
+
+    etag = md5_hex
+    ts = now_msec()
+    upd = MultipartUpload(mpu.upload_id, bucket_id, key, timestamp=mpu.timestamp)
+    upd.parts.put([part_number, ts], {"vid": vid, "etag": etag, "s": total})
+    await garage.mpu_table.insert(upd)
+    from .xml_util import http_iso
+
+    return web.Response(
+        text=xml_doc(
+            "CopyPartResult",
+            [("LastModified", http_iso(ts)), ("ETag", f'"{etag}"')],
+        ),
+        content_type="application/xml",
+    )
+
+
 async def handle_complete_multipart_upload(garage, bucket_id, key, request, ctx=None):
     body = await request.read()
     from ..common.signature import check_payload
@@ -131,8 +246,15 @@ async def handle_complete_multipart_upload(garage, bucket_id, key, request, ctx=
         raise BadRequest(f"malformed CompleteMultipartUpload XML: {e}") from e
     if not req_parts:
         raise BadRequest("no parts in CompleteMultipartUpload")
-    if [p for p, _ in req_parts] != sorted(p for p, _ in req_parts):
-        raise BadRequest("parts must be listed in ascending order", code="InvalidPartOrder")
+    # strictly increasing (reference multipart.rs InvalidPartOrder): a
+    # duplicated PartNumber would be assembled once but double-counted in
+    # size/ETag-part-count metadata
+    pns = [p for p, _ in req_parts]
+    if any(p1 >= p2 for p1, p2 in zip(pns, pns[1:])):
+        raise BadRequest(
+            "parts must be listed in strictly ascending order",
+            code="InvalidPartOrder",
+        )
 
     have = mpu.latest_parts()
     for pn, etag in req_parts:

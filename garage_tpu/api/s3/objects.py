@@ -293,14 +293,73 @@ def _http_date(ts_ms: int) -> str:
     return dt.strftime("%a, %d %b %Y %H:%M:%S GMT")
 
 
+def _parse_http_date(s: str) -> float:
+    from email.utils import parsedate_to_datetime
+
+    from ..common.error import BadRequest
+
+    try:
+        return parsedate_to_datetime(s).timestamp()
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f"invalid HTTP date {s!r}") from e
+
+
+class Preconditions:
+    """RFC 7232 §6 conditional evaluation (reference get.rs:783-885),
+    shared by GET/HEAD and the x-amz-copy-source-if-* variants."""
+
+    __slots__ = ("if_match", "if_none_match", "if_modified_since",
+                 "if_unmodified_since")
+
+    _HDRS = ("If-Match", "If-None-Match", "If-Modified-Since",
+             "If-Unmodified-Since")
+    _COPY_HDRS = tuple(f"x-amz-copy-source-{h.lower()}" for h in _HDRS)
+
+    def __init__(self, headers, names):
+        im, inm, ims, ius = (headers.get(n) for n in names)
+        etags = lambda v: [e.strip().strip('"') for e in v.split(",")]  # noqa: E731
+        self.if_match = etags(im) if im is not None else None
+        self.if_none_match = etags(inm) if inm is not None else None
+        self.if_modified_since = _parse_http_date(ims) if ims else None
+        self.if_unmodified_since = _parse_http_date(ius) if ius else None
+
+    @classmethod
+    def parse(cls, request) -> "Preconditions":
+        return cls(request.headers, cls._HDRS)
+
+    @classmethod
+    def parse_copy_source(cls, request) -> "Preconditions":
+        return cls(request.headers, cls._COPY_HDRS)
+
+    def check(self, version: ObjectVersion) -> int | None:
+        """Returns 304/412 when a precondition short-circuits, else None."""
+        etag = version.data.get("meta", {}).get("etag", "")
+        v_date = version.timestamp / 1000.0
+        if self.if_match is not None:
+            if not any(x == etag or x == "*" for x in self.if_match):
+                return 412
+        elif self.if_unmodified_since is not None:
+            if v_date > self.if_unmodified_since:
+                return 412
+        if self.if_none_match is not None:
+            if any(x == etag or x == "*" for x in self.if_none_match):
+                return 304
+        elif self.if_modified_since is not None:
+            if v_date <= self.if_modified_since:
+                return 304
+        return None
+
+    def check_copy_source(self, version: ObjectVersion) -> None:
+        if self.check(version) is not None:
+            raise PreconditionFailed("copy source precondition failed")
+
+
 def _check_conditionals(request, version: ObjectVersion) -> None:
-    etag = version.data.get("meta", {}).get("etag", "")
-    inm = request.headers.get("If-None-Match")
-    if inm and (inm == "*" or etag in [e.strip(' "') for e in inm.split(",")]):
+    status = Preconditions.parse(request).check(version)
+    if status == 304:
         raise ApiError("not modified", code="NotModified", status=304)
-    im = request.headers.get("If-Match")
-    if im and etag not in [e.strip(' "') for e in im.split(",")]:
-        raise PreconditionFailed("If-Match failed")
+    if status == 412:
+        raise PreconditionFailed("precondition failed")
 
 
 def _parse_range(request, size: int) -> tuple[int, int] | None:
@@ -324,10 +383,80 @@ def _parse_range(request, size: int) -> tuple[int, int] | None:
     return (start, min(end, size))
 
 
+def _plain_len(blk: dict, enc_params) -> int:
+    from .encryption import OVERHEAD
+
+    return blk["s"] - (OVERHEAD if enc_params is not None else 0)
+
+
+def part_bounds(blocks, part_number: int, enc_params) -> tuple[int, int] | None:
+    """Plaintext [begin, end) extent of a stored part (reference
+    get.rs:620-633 calculate_part_bounds), or None if no such part."""
+    offset = 0
+    begin = None
+    for (pn, _off), blk in blocks:
+        if pn == part_number and begin is None:
+            begin = offset
+        elif pn != part_number and begin is not None:
+            return (begin, offset)
+        offset += _plain_len(blk, enc_params)
+    return (begin, offset) if begin is not None else None
+
+
+async def plain_block_stream(garage, blocks, start: int, end: int, enc_params):
+    """Async generator of plaintext chunks covering [start, end) of a
+    version's block list, prefetching one block ahead (the GET hot loop,
+    reference get.rs:650-760) — shared by GetObject and UploadPartCopy."""
+    wanted: list[tuple[int, int, bytes]] = []  # (blk_start, blk_end, hash)
+    pos = 0
+    for (_part, _off), blk in blocks:
+        b_start, b_end = pos, pos + _plain_len(blk, enc_params)
+        pos = b_end
+        if b_end <= start or b_start >= end:
+            continue
+        wanted.append((b_start, b_end, blk["h"]))
+
+    async def fetch(h):
+        return await garage.block_manager.rpc_get_block(h)
+
+    next_task: asyncio.Task | None = None
+    try:
+        for i, (b_start, b_end, h) in enumerate(wanted):
+            data = await (next_task if next_task else fetch(h))
+            next_task = None
+            if i + 1 < len(wanted):
+                next_task = asyncio.create_task(fetch(wanted[i + 1][2]))
+            if enc_params is not None:
+                data = enc_params.decrypt_block(data)
+            lo = max(start - b_start, 0)
+            hi = min(end, b_end) - b_start
+            yield data[lo:hi]
+    finally:
+        if next_task:
+            next_task.cancel()
+
+
+def _parse_part_number(request) -> int | None:
+    pn_s = request.query.get("partNumber")
+    if pn_s is None:
+        return None
+    from ..common.error import BadRequest
+
+    try:
+        pn = int(pn_s)
+    except ValueError as e:
+        raise BadRequest(f"bad partNumber {pn_s!r}") from e
+    if not 1 <= pn <= 10000:
+        raise BadRequest("partNumber must be in 1..10000")
+    if "Range" in request.headers:
+        raise BadRequest("cannot specify both partNumber and Range")
+    return pn
+
+
 async def handle_get_object(
     garage, bucket_id: bytes, key: str, request, head_only: bool = False
 ) -> web.StreamResponse:
-    from .encryption import OVERHEAD, EncryptionParams, check_match
+    from .encryption import EncryptionParams, check_match
 
     obj = await garage.object_table.get(bucket_id, key.encode())
     version = _pick_version(obj)
@@ -340,18 +469,47 @@ async def handle_get_object(
     if enc_params is not None:
         headers.update(enc_params.response_headers())
 
-    rng = _parse_range(request, size) if not head_only else None
+    part_number = _parse_part_number(request)
+    is_inline = version.data.get("t") == "inline"
+    blocks = None
+    # plain HEAD never needs the block list — don't pay a version-table
+    # quorum read on that hot path
+    if not is_inline and (part_number is not None or not head_only):
+        ver = await garage.version_table.get(version.data["vid"], b"")
+        if ver is None or ver.deleted.get():
+            raise NoSuchKey("version data missing")
+        blocks = ver.sorted_blocks()
+
     status = 200
-    if rng is not None:
+    if part_number is not None:
+        # part-number read (reference get.rs:144-190, 534-592): a ranged
+        # read over the part's stored extent, with the parts count exposed
+        if is_inline:
+            if part_number != 1:
+                raise ApiError("no such part", code="InvalidPart", status=400)
+            rng = (0, size)
+            n_parts = 1
+        else:
+            b = part_bounds(blocks, part_number, enc_params)
+            if b is None:
+                raise ApiError("no such part", code="InvalidPart", status=400)
+            rng = b
+            n_parts = len({pn for (pn, _off), _blk in blocks})
+        headers["x-amz-mp-parts-count"] = str(n_parts)
+        status = 206
+    else:
+        rng = _parse_range(request, size)
+        if rng is not None:
+            status = 206
+    if rng is not None and status == 206:
         start, end = rng
         headers["Content-Range"] = f"bytes {start}-{end - 1}/{size}"
         headers["Content-Length"] = str(end - start)
-        status = 206
 
     if head_only:
-        return web.Response(status=200, headers=headers)
+        return web.Response(status=status, headers=headers)
 
-    if version.data.get("t") == "inline":
+    if is_inline:
         data = version.data["bytes"]
         if enc_params is not None:
             data = enc_params.decrypt_block(data)
@@ -359,47 +517,12 @@ async def handle_get_object(
             data = data[rng[0] : rng[1]]
         return web.Response(status=status, body=data, headers=headers)
 
-    # block version: stream, prefetching one block ahead
-    vid = version.data["vid"]
-    ver = await garage.version_table.get(vid, b"")
-    if ver is None or ver.deleted.get():
-        raise NoSuchKey("version data missing")
-    blocks = ver.sorted_blocks()
     start, end = rng if rng is not None else (0, size)
-
     resp = web.StreamResponse(status=status, headers=headers)
     await resp.prepare(request)
-
-    async def fetch(h):
-        return await garage.block_manager.rpc_get_block(h)
-
-    pos = 0
-    next_task: asyncio.Task | None = None
-    try:
-        # plaintext extents: encrypted blocks carry OVERHEAD framing bytes
-        wanted: list[tuple[int, int, bytes]] = []  # (blk_start, blk_end, hash)
-        for (_part, _off), blk in blocks:
-            plain_len = blk["s"] - (OVERHEAD if enc_params is not None else 0)
-            b_start, b_end = pos, pos + plain_len
-            pos = b_end
-            if b_end <= start or b_start >= end:
-                continue
-            wanted.append((b_start, b_end, blk["h"]))
-        for i, (b_start, b_end, h) in enumerate(wanted):
-            data = await (next_task if next_task else fetch(h))
-            if next_task:
-                next_task = None
-            if i + 1 < len(wanted):
-                next_task = asyncio.create_task(fetch(wanted[i + 1][2]))
-            if enc_params is not None:
-                data = enc_params.decrypt_block(data)
-            lo = max(start - b_start, 0)
-            hi = min(end, b_end) - b_start
-            await resp.write(data[lo:hi])
-        await resp.write_eof()
-    finally:
-        if next_task:
-            next_task.cancel()
+    async for chunk in plain_block_stream(garage, blocks, start, end, enc_params):
+        await resp.write(chunk)
+    await resp.write_eof()
     return resp
 
 

@@ -141,7 +141,10 @@ class BlockManager:
         from .repair import ScrubWorker
 
         self.resync.spawn_workers(bg)
-        bg.spawn(ScrubWorker(self, metadata_dir=self.metadata_dir))
+        # kept as an attribute so the admin scrub controls (pause/resume/
+        # cancel/tranquility) can reach the running worker
+        self.scrub_worker = ScrubWorker(self, metadata_dir=self.metadata_dir)
+        bg.spawn(self.scrub_worker)
 
     # --- placement -----------------------------------------------------------
 
@@ -253,6 +256,9 @@ class BlockManager:
         return True
 
     async def _quarantine(self, path: str) -> None:
+        from ..utils.metrics import registry
+
+        registry.incr("block_corrupted_count")
         try:
             os.replace(path, path + ".corrupted")
         except OSError:

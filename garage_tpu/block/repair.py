@@ -106,6 +106,7 @@ class ScrubWorker(Worker):
             else None
         )
         self.state = (self.persister.load() if self.persister else None) or ScrubPersisted()
+        self.paused = False
 
     def name(self) -> str:
         return "scrub"
@@ -114,9 +115,36 @@ class ScrubWorker(Worker):
         return {
             "cursor": self.state.cursor.hex()[:16],
             "corruptions": self.state.corruptions,
+            "paused": self.paused,
         }
 
+    # --- operator controls (reference `garage repair scrub {…}`) -------------
+
+    def cmd_start(self) -> None:
+        """Begin a fresh pass immediately."""
+        self.state.cursor = b""
+        self.paused = False
+        self._save()
+
+    def cmd_pause(self) -> None:
+        self.paused = True
+
+    def cmd_resume(self) -> None:
+        self.paused = False
+
+    def cmd_cancel(self) -> None:
+        """Abort the in-progress pass (the next one starts from zero)."""
+        self.state.cursor = b""
+        self.paused = True
+        self._save()
+
+    def cmd_set_tranquility(self, t: int) -> None:
+        self.state.tranquility = max(0, int(t))
+        self._save()
+
     async def work(self):
+        if self.paused:
+            return (WorkerState.THROTTLED, 5.0)
         self.tranquilizer.reset()
         n = 0
         for key, _v in self.manager.rc.tree.iter_range(start=self.state.cursor):

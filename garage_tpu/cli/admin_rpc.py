@@ -116,6 +116,212 @@ class AdminRpcHandler:
             "partition_size": cur.partition_size,
         }
 
+    async def op_layout_config(self, args) -> Any:
+        """Stage layout parameters (reference cli layout config -r):
+        zone_redundancy = "maximum" or an integer."""
+        zr = args.get("zone_redundancy")
+        if zr is None:
+            raise ValueError("zone_redundancy required")
+        from ..rpc.layout.types import ZoneRedundancy
+
+        val = ZoneRedundancy.MAXIMUM if zr == "maximum" else int(zr)
+        self.garage.layout_manager.local_update(
+            lambda h: h.staging.parameters.update({"zone_redundancy": val})
+        )
+        return f"staged zone_redundancy = {zr}"
+
+    async def op_layout_history(self, args) -> Any:
+        """Layout version history + per-node update trackers (reference
+        cli layout history)."""
+        h = self.garage.layout_manager.history
+        nodes = h.all_nodes()
+        return {
+            "current_version": h.current().version,
+            "min_stored": h.min_stored(),
+            "versions": [
+                {
+                    "version": v.version,
+                    "status": "current" if v is h.current() else "draining",
+                    "storage_nodes": len(v.storage_nodes()),
+                    "gateway_nodes": len(v.all_nodes()) - len(v.storage_nodes()),
+                }
+                for v in h.versions
+            ],
+            "trackers": {
+                hex_of(n): {
+                    "ack": h.ack.get(n),
+                    "sync": h.sync.get(n),
+                    "sync_ack": h.sync_ack.get(n),
+                }
+                for n in nodes
+            },
+        }
+
+    async def op_layout_skip_dead_nodes(self, args) -> Any:
+        """Force dead nodes' trackers forward so a stuck layout transition
+        can complete without them (reference cli layout skip-dead-nodes
+        --version N [--allow-missing-data])."""
+        version = args.get("version")
+        allow_missing = bool(args.get("allow_missing_data"))
+        lm = self.garage.layout_manager
+        h = lm.history
+        if version is None:
+            version = h.current().version
+        if version > h.current().version:
+            raise ValueError(f"version {version} does not exist yet")
+        skipped = []
+
+        def mutate(hist):
+            for n in hist.all_nodes():
+                if self.garage.netapp.is_connected(n) or n == self.garage.node_id:
+                    continue
+                changed = hist.ack.set_max(n, version)
+                if allow_missing:
+                    changed = hist.sync.set_max(n, version) or changed
+                    changed = hist.sync_ack.set_max(n, version) or changed
+                if changed:
+                    skipped.append(hex_of(n))
+
+        lm.local_update(mutate)  # persists + gossips to connected peers
+        return {"version": version, "skipped_nodes": skipped}
+
+    # --- block operations (reference src/garage/cli block subcommands) --------
+
+    async def op_block_list_errors(self, args) -> Any:
+        from ..utils.serde import unpack
+        from ..utils.time_util import now_msec
+
+        resync = self.garage.block_manager.resync
+        out = []
+        for h, v in resync.errors.iter_range():
+            count, next_try = unpack(v)
+            out.append(
+                {
+                    "hash": h.hex(),
+                    "failures": count,
+                    "next_try_in_secs": max(0, (next_try - now_msec()) // 1000),
+                }
+            )
+        return out
+
+    def _resolve_block_hash(self, prefix_hex: str) -> bytes:
+        """Accept a full hash or an unambiguous hex prefix."""
+        bm = self.garage.block_manager
+        prefix = bytes.fromhex(
+            prefix_hex if len(prefix_hex) % 2 == 0 else prefix_hex[:-1]
+        )
+        matches = []
+        for h, _v in bm.rc.tree.iter_range(start=prefix):
+            if not h.startswith(prefix):
+                break
+            if not h.hex().startswith(prefix_hex):
+                continue  # odd-length prefix: half-byte mismatch, keep scanning
+            matches.append(h)
+            if len(matches) > 2:
+                break
+        if not matches:
+            raise ValueError(f"no block with hash prefix {prefix_hex}")
+        if len(matches) > 1:
+            raise ValueError(f"ambiguous hash prefix {prefix_hex}")
+        return matches[0]
+
+    async def op_block_info(self, args) -> Any:
+        g = self.garage
+        bm = g.block_manager
+        h = self._resolve_block_hash(args["hash"])
+        refs = []
+        truncated = False
+        async for ref in self._iter_block_refs(h):
+            if ref.deleted.get():
+                continue
+            if len(refs) >= 1000:
+                truncated = True
+                break
+            ver = await g.version_table.get_local(bytes(ref.version), b"")
+            refs.append(
+                {
+                    "version": bytes(ref.version).hex(),
+                    "bucket_id": hex_of(ver.bucket_id) if ver else None,
+                    "key": ver.key if ver else None,
+                    "deleted": ver.deleted.get() if ver else None,
+                }
+            )
+        from ..utils.serde import unpack
+
+        err = bm.resync.errors.get(h)
+        return {
+            "hash": h.hex(),
+            "refcount": bm.rc.get(h),
+            "needed": bm.rc.is_needed(h),
+            "stored_locally": bm.find_block_file(h) is not None
+            or bool(bm.local_pieces(h)),
+            "error_count": unpack(err)[0] if err else 0,
+            "refs": refs,
+            "refs_truncated": truncated,
+        }
+
+    async def _iter_block_refs(self, h: bytes):
+        """Page through ALL local refs of a block (no silent 1000 cap)."""
+        cursor = None
+        while True:
+            batch = await self.garage.block_ref_table.get_range_local(
+                h, cursor, None, 1000
+            )
+            for ref in batch:
+                yield ref
+            if len(batch) < 1000:
+                return
+            cursor = bytes(batch[-1].version) + b"\x00"
+
+    async def op_block_retry_now(self, args) -> Any:
+        resync = self.garage.block_manager.resync
+        if args.get("all"):
+            hashes = [h for h, _v in resync.errors.iter_range()]
+        else:
+            hashes = [self._resolve_block_hash(args["hash"])]
+        for h in hashes:
+            resync.errors.remove(h)
+            resync.queue_block(h)
+        return f"{len(hashes)} blocks requeued for immediate resync"
+
+    async def op_block_purge(self, args) -> Any:
+        """Delete every object version referencing a block — the way out
+        when a block is irrecoverably lost (reference block purge)."""
+        if not args.get("yes"):
+            raise ValueError("refusing to purge without yes=true")
+        g = self.garage
+        h = self._resolve_block_hash(args["hash"])
+        from ..model.s3.object_table import Object, ObjectVersion
+        from ..model.s3.version_table import Version
+        from ..utils.data import gen_uuid
+        from ..utils.time_util import now_msec
+
+        versions = objects = 0
+        async for ref in self._iter_block_refs(h):
+            if ref.deleted.get():
+                continue
+            ver = await g.version_table.get(bytes(ref.version), b"")
+            if ver is None:
+                continue
+            if not ver.deleted.get():
+                await g.version_table.insert(
+                    Version.deleted_marker(ver.uuid, ver.bucket_id, ver.key)
+                )
+                versions += 1
+            obj = await g.object_table.get(ver.bucket_id, ver.key.encode())
+            if obj is not None and any(
+                v.uuid == ver.uuid or v.data.get("vid") == ver.uuid
+                for v in obj.versions
+            ):
+                dm = ObjectVersion(
+                    gen_uuid(), now_msec(), "complete", {"t": "delete_marker"}
+                )
+                await g.object_table.insert(
+                    Object(ver.bucket_id, ver.key, [dm])
+                )
+                objects += 1
+        return {"hash": h.hex(), "versions_deleted": versions, "objects_deleted": objects}
+
     # --- buckets --------------------------------------------------------------
 
     async def op_bucket_list(self, args) -> Any:
@@ -220,6 +426,11 @@ class AdminRpcHandler:
     async def op_repair(self, args) -> Any:
         what = args.get("what", "blocks")
         from ..block.repair import RebalanceWorker, RepairWorker
+        from ..model.repair import (
+            BlockRefRepairWorker,
+            MpuRepairWorker,
+            VersionRepairWorker,
+        )
 
         if what == "blocks":
             self.garage.bg.spawn(RepairWorker(self.garage.block_manager))
@@ -228,6 +439,30 @@ class AdminRpcHandler:
         elif what == "tables":
             for t in self.garage.tables:
                 await t.syncer.sync_all_partitions()
+        elif what == "versions":
+            self.garage.bg.spawn(VersionRepairWorker(self.garage))
+        elif what == "mpu":
+            self.garage.bg.spawn(MpuRepairWorker(self.garage))
+        elif what == "block-refs":
+            self.garage.bg.spawn(BlockRefRepairWorker(self.garage))
+        elif what == "scrub":
+            sw = getattr(self.garage.block_manager, "scrub_worker", None)
+            if sw is None:
+                raise ValueError("scrub worker not running")
+            cmd = args.get("cmd", "start")
+            if cmd == "start":
+                sw.cmd_start()
+            elif cmd == "pause":
+                sw.cmd_pause()
+            elif cmd == "resume":
+                sw.cmd_resume()
+            elif cmd == "cancel":
+                sw.cmd_cancel()
+            elif cmd == "set-tranquility":
+                sw.cmd_set_tranquility(int(args["value"]))
+            else:
+                raise ValueError(f"unknown scrub command {cmd!r}")
+            return {"scrub": sw.status()}
         else:
             raise ValueError(f"unknown repair target {what!r}")
         return f"repair {what} launched"

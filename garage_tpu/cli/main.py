@@ -62,6 +62,30 @@ def main(argv=None):
     app.add_argument("--version", type=int)
     lay_sub.add_parser("show")
     lay_sub.add_parser("revert")
+    lcfg = lay_sub.add_parser("config")
+    lcfg.add_argument(
+        "-r", "--zone-redundancy", required=True,
+        help='"maximum" or an integer number of distinct zones per partition',
+    )
+    lay_sub.add_parser("history")
+    skd = lay_sub.add_parser("skip-dead-nodes")
+    skd.add_argument("--version", type=int)
+    skd.add_argument(
+        "--allow-missing-data", action="store_true",
+        help="also mark dead nodes as synced (data they held is abandoned)",
+    )
+
+    blk = sub.add_parser("block")
+    blk_sub = blk.add_subparsers(dest="block_cmd", required=True)
+    blk_sub.add_parser("list-errors")
+    binf = blk_sub.add_parser("info")
+    binf.add_argument("hash")
+    brn = blk_sub.add_parser("retry-now")
+    brn.add_argument("hash", nargs="?")
+    brn.add_argument("--all", action="store_true")
+    bpg = blk_sub.add_parser("purge")
+    bpg.add_argument("hash")
+    bpg.add_argument("--yes", action="store_true", required=True)
 
     bkt = sub.add_parser("bucket")
     bkt_sub = bkt.add_subparsers(dest="bucket_cmd", required=True)
@@ -96,7 +120,16 @@ def main(argv=None):
     wrk.add_argument("var", nargs="?")
     wrk.add_argument("value", nargs="?")
     rep = sub.add_parser("repair")
-    rep.add_argument("what", choices=["blocks", "rebalance", "tables"])
+    rep.add_argument(
+        "what",
+        choices=["blocks", "rebalance", "tables", "versions", "mpu",
+                 "block-refs", "scrub"],
+    )
+    rep.add_argument(
+        "scrub_cmd", nargs="?",
+        choices=["start", "pause", "resume", "cancel", "set-tranquility"],
+    )
+    rep.add_argument("scrub_value", nargs="?")
     meta = sub.add_parser("meta")
     meta.add_argument("meta_cmd", choices=["snapshot"])
     cdb = sub.add_parser("convert-db", help="copy the metadata db between engines")
@@ -346,6 +379,40 @@ async def dispatch(args, call, config) -> str | None:
             return f"layout version {r['version']} applied:\n" + "\n".join(r["report"])
         if lc == "revert":
             return str(await call("layout-revert"))
+        if lc == "config":
+            return str(
+                await call("layout-config", {"zone_redundancy": args.zone_redundancy})
+            )
+        if lc == "history":
+            r = await call("layout-history")
+            if jd:
+                return jd(r)
+            rows = [
+                f"current version\t{r['current_version']}",
+                f"oldest active\t{r['min_stored']}",
+            ]
+            for v in r["versions"]:
+                rows.append(
+                    f"v{v['version']}\t{v['status']}\t"
+                    f"{v['storage_nodes']} storage / {v['gateway_nodes']} gateway"
+                )
+            rows.append("-- update trackers --")
+            rows.append("node\tack\tsync\tsync_ack")
+            for nid, t in r["trackers"].items():
+                rows.append(f"{nid[:16]}\t{t['ack']}\t{t['sync']}\t{t['sync_ack']}")
+            return format_table(rows)
+        if lc == "skip-dead-nodes":
+            r = await call(
+                "layout-skip-dead-nodes",
+                {
+                    "version": args.version,
+                    "allow_missing_data": args.allow_missing_data,
+                },
+            )
+            return (
+                f"trackers forced to v{r['version']} for: "
+                + (", ".join(n[:16] for n in r["skipped_nodes"]) or "(none)")
+            )
         if lc == "show":
             r = await call("layout-show")
             if jd:
@@ -430,8 +497,43 @@ async def dispatch(args, call, config) -> str | None:
             )
         return format_table(rows)
 
+    if args.cmd == "block":
+        bc = args.block_cmd
+        if bc == "list-errors":
+            errs = await call("block-list-errors")
+            if jd:
+                return jd(errs)
+            rows = ["hash\tfailures\tnext try in"]
+            for e in errs:
+                rows.append(
+                    f"{e['hash'][:16]}\t{e['failures']}\t{e['next_try_in_secs']}s"
+                )
+            return format_table(rows)
+        if bc == "info":
+            return json.dumps(
+                await call("block-info", {"hash": args.hash}), indent=2, default=repr
+            )
+        if bc == "retry-now":
+            if not args.all and not args.hash:
+                return "error: give a hash or --all"
+            return str(
+                await call(
+                    "block-retry-now", {"hash": args.hash, "all": args.all}
+                )
+            )
+        if bc == "purge":
+            return json.dumps(
+                await call("block-purge", {"hash": args.hash, "yes": args.yes}),
+                indent=2,
+            )
+
     if args.cmd == "repair":
-        return str(await call("repair", {"what": args.what}))
+        a = {"what": args.what}
+        if args.what == "scrub":
+            a["cmd"] = args.scrub_cmd or "start"
+            if args.scrub_value is not None:
+                a["value"] = args.scrub_value
+        return str(await call("repair", a))
 
     if args.cmd == "meta" and args.meta_cmd == "snapshot":
         return json.dumps(await call("meta-snapshot"))

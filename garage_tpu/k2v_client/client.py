@@ -97,6 +97,46 @@ class K2VClient:
             raise K2VError(st, data.decode(errors="replace"))
         return [base64.b64decode(v) for v in json.loads(data)], h.get(TOKEN_HEADER, "")
 
+    async def poll_range(
+        self,
+        pk: str,
+        seen_marker: str | None = None,
+        start: str | None = None,
+        end: str | None = None,
+        prefix: str | None = None,
+        timeout: float = 60,
+    ):
+        """-> ({sk: {"ct":…, "v":[bytes|None]}}, seen_marker) or None (304)."""
+        body = {"timeout": timeout}
+        if seen_marker is not None:
+            body["seenMarker"] = seen_marker
+        for k, v in (("start", start), ("end", end), ("prefix", prefix)):
+            if v is not None:
+                body[k] = v
+        st, _h, data = await self._req(
+            "POST",
+            f"/{self.bucket}/{urllib.parse.quote(pk, safe='')}",
+            query=[("poll_range", "")],
+            body=json.dumps(body).encode(),
+            timeout=timeout + 30,
+        )
+        if st == 304:
+            return None
+        if st != 200:
+            raise K2VError(st, data.decode(errors="replace"))
+        res = json.loads(data)
+        items = {
+            it["sk"]: {
+                "ct": it["ct"],
+                "v": [
+                    base64.b64decode(v) if v is not None else None
+                    for v in it["v"]
+                ],
+            }
+            for it in res["items"]
+        }
+        return items, res["seenMarker"]
+
     # --- index + batch --------------------------------------------------------
 
     async def read_index(self, prefix: str = "", limit: int = 1000) -> dict:

@@ -94,6 +94,8 @@ class Garage:
         public_addr = (
             _parse_addr(config.rpc_public_addr) if config.rpc_public_addr else None
         )
+        from ..rpc.discovery import discovery_from_config
+
         self.system = System(
             self.netapp,
             self.layout_manager,
@@ -103,6 +105,7 @@ class Garage:
             metadata_dir=meta,
             data_dirs=[d.path for d in config.data_dir],
             public_addr=public_addr,
+            discovery=discovery_from_config(config),
         )
         self.helper_rpc = RpcHelper(
             self.node_id, self.system.peering,
@@ -220,7 +223,42 @@ class Garage:
         host, port = _parse_addr(self.config.rpc_bind_addr)
         await self.netapp.listen(host, port)
         await self.system.start()
+        from ..utils.tracing import tracer
+
+        if self.config.admin.trace_sink:
+            tracer.configure(self.config.admin.trace_sink)
+            await tracer.start()
+        self._register_gauges()
         self._started = True
+
+    def _register_gauges(self) -> None:
+        """Backlog/queue gauges, polled at scrape time (reference
+        src/block/metrics.rs, src/table/metrics.rs)."""
+        from ..utils.metrics import registry
+
+        self._gauge_keys: list[tuple] = []
+
+        def reg(name: str, labels: tuple, fn) -> None:
+            registry.register_gauge(name, labels, fn)
+            self._gauge_keys.append((name, labels))
+
+        resync = self.block_manager.resync
+        reg("block_resync_queue_length", (), lambda: len(resync.queue))
+        reg("block_resync_errored_blocks", (), lambda: len(resync.errors))
+        for t in self.tables:
+            lbl = (("table_name", t.schema.table_name),)
+            reg(
+                "table_merkle_updater_todo_queue_length", lbl,
+                lambda d=t.data: len(d.merkle_todo),
+            )
+            reg(
+                "table_gc_todo_queue_length", lbl,
+                lambda d=t.data: len(d.gc_todo),
+            )
+        reg(
+            "cluster_connected_nodes", (),
+            lambda: len(self.system.peering.connected_peers()),
+        )
 
     def spawn_workers(self) -> None:
         for t in self.tables:
@@ -234,7 +272,15 @@ class Garage:
             self.bg.spawn(SnapshotWorker(self))
 
     async def stop(self) -> None:
+        from ..utils.tracing import tracer
+
         await self.bg.shutdown()
         await self.system.stop()
         await self.netapp.shutdown()
+        if self.config.admin.trace_sink:
+            await tracer.stop()
+        from ..utils.metrics import registry
+
+        for name, labels in getattr(self, "_gauge_keys", []):
+            registry.unregister_gauge(name, labels)
         self.db.close()

@@ -94,7 +94,7 @@ class GarageHelper:
 
     async def list_buckets(self) -> list[Bucket]:
         out = []
-        aliases = await self.garage.bucket_alias_table.get_range(b"", limit=10000)
+        aliases = await self.garage.bucket_alias_table.get_all_local()
         seen = set()
         for a in aliases:
             bid = a.state.get()
@@ -105,6 +105,75 @@ class GarageHelper:
                 except Error:
                     pass
         return out
+
+    # --- aliases (reference helper/locked.rs alias ops) -----------------------
+
+    async def set_global_alias(self, bucket_id: bytes, alias: str) -> None:
+        if not valid_bucket_name(alias):
+            raise Error(f"invalid alias {alias!r}")
+        async with self.lock:
+            bucket = await self.get_bucket(bucket_id)
+            existing = await self.garage.bucket_alias_table.get(alias.encode(), b"")
+            if (
+                existing is not None
+                and existing.state.get() is not None
+                and bytes(existing.state.get()) != bucket_id
+            ):
+                raise Error(f"alias {alias!r} already points to another bucket")
+            if existing is not None:
+                existing.state.update(bucket_id)
+                await self.garage.bucket_alias_table.insert(existing)
+            else:
+                await self.garage.bucket_alias_table.insert(
+                    BucketAlias.new(alias, bucket_id)
+                )
+            bucket.params().aliases.update_in_place(alias, True)
+            await self.garage.bucket_table.insert(bucket)
+
+    async def unset_global_alias(self, bucket_id: bytes, alias: str) -> None:
+        async with self.lock:
+            bucket = await self.get_bucket(bucket_id)
+            params = bucket.params()
+            live = [n for n, v in params.aliases.items() if v]
+            has_local = any(
+                True
+                for k in await self.list_keys()
+                for n, b in k.params().local_aliases.items()
+                if b is not None and bytes(b) == bucket_id
+            )
+            if live == [alias] and not has_local:
+                raise Error(
+                    f"{alias!r} is the bucket's last alias; removing it would "
+                    "make the bucket unreachable"
+                )
+            a = await self.garage.bucket_alias_table.get(alias.encode(), b"")
+            if a is None or a.state.get() is None or bytes(a.state.get()) != bucket_id:
+                raise Error(f"alias {alias!r} does not point to this bucket")
+            a.state.update(None)
+            await self.garage.bucket_alias_table.insert(a)
+            params.aliases.update_in_place(alias, False)
+            await self.garage.bucket_table.insert(bucket)
+
+    async def set_local_alias(self, bucket_id: bytes, key_id: str, alias: str) -> None:
+        if not valid_bucket_name(alias):
+            raise Error(f"invalid alias {alias!r}")
+        async with self.lock:
+            await self.get_bucket(bucket_id)
+            key = await self.get_key(key_id)
+            cur = key.params().local_aliases.get(alias)
+            if cur is not None and bytes(cur) != bucket_id:
+                raise Error(f"key already uses alias {alias!r} for another bucket")
+            key.params().local_aliases.update_in_place(alias, bucket_id)
+            await self.garage.key_table.insert(key)
+
+    async def unset_local_alias(self, bucket_id: bytes, key_id: str, alias: str) -> None:
+        async with self.lock:
+            key = await self.get_key(key_id)
+            cur = key.params().local_aliases.get(alias)
+            if cur is None or bytes(cur) != bucket_id:
+                raise Error(f"alias {alias!r} does not point to this bucket")
+            key.params().local_aliases.update_in_place(alias, None)
+            await self.garage.key_table.insert(key)
 
     # --- key lifecycle --------------------------------------------------------
 
@@ -120,8 +189,47 @@ class GarageHelper:
             await self.garage.key_table.insert(key)
 
     async def list_keys(self) -> list[Key]:
-        ks = await self.garage.key_table.get_range(b"", limit=10000)
+        ks = await self.garage.key_table.get_all_local()
         return [k for k in ks if not k.is_deleted()]
+
+    async def update_key(
+        self,
+        key_id: str,
+        name: str | None = None,
+        allow_create_bucket: bool | None = None,
+    ) -> Key:
+        async with self.lock:
+            key = await self.get_key(key_id)
+            if name is not None:
+                key.params().name.update(name)
+            if allow_create_bucket is not None:
+                key.params().allow_create_bucket.update(allow_create_bucket)
+            await self.garage.key_table.insert(key)
+            return key
+
+    async def import_key(self, key_id: str, secret: str, name: str = "") -> Key:
+        """Import an existing credential pair (reference key import)."""
+        from .key_table import KeyParams
+        from ..utils.crdt import Deletable
+
+        if not key_id.startswith("GK") or len(secret) != 64:
+            raise Error("malformed key id or secret")
+        async with self.lock:
+            existing = await self.garage.key_table.get(key_id.encode(), b"")
+            if existing is not None:
+                # a deleted key leaves a delete-wins CRDT tombstone: an
+                # import under the same id would silently converge back to
+                # deleted — refuse instead of lying
+                raise Error(
+                    f"key {key_id} already exists"
+                    if not existing.is_deleted()
+                    else f"key id {key_id} was deleted and cannot be reused"
+                )
+            params = KeyParams(secret)
+            params.name.update(name)
+            key = Key(key_id, Deletable.present(params))
+            await self.garage.key_table.insert(key)
+            return key
 
     async def set_bucket_key_permissions(
         self, bucket_id: bytes, key_id: str, read: bool, write: bool, owner: bool

@@ -68,10 +68,23 @@ class K2VItem:
             vv[node] = max(e["t"], *[t for t, _v in e["v"]] or [0])
         return CausalContext(vv)
 
-    def update(self, this_node: bytes, context: CausalContext | None, value: bytes | None) -> None:
+    def update(
+        self,
+        this_node: bytes,
+        context: CausalContext | None,
+        value: bytes | None,
+        node_ts: int = 0,
+    ) -> int:
         """Apply a write allocated on this_node (reference item_table.rs
         update()): discard everything the writer has seen, then append the
-        new value with a fresh dot."""
+        new value with a fresh dot.
+
+        `node_ts` is the writer node's GLOBAL monotonic timestamp floor
+        (reference rpc.rs local_insert: max(persisted, now_msec)).  Dots
+        must be monotonic per NODE — not just per item — because the
+        PollRange seen-marker's vector clock asserts "every item this node
+        produced with t <= clock has been seen" (seen.py).  Returns the
+        allocated timestamp."""
         if context is not None:
             for node, seen_t in context.vv.items():
                 # nodes we have no entry for yet STILL get their horizon
@@ -81,9 +94,10 @@ class K2VItem:
                 if seen_t > e["t"]:
                     e["t"] = seen_t
                     e["v"] = [[t, v] for t, v in e["v"] if t > seen_t]
-        new_t = self.max_t() + 1
+        new_t = max(self.max_t(), node_ts) + 1
         e = self.items.setdefault(this_node, {"t": 0, "v": []})
         e["v"].append([new_t, value])
+        return new_t
 
     def values(self) -> list[bytes | None]:
         out = []

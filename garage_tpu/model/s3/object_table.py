@@ -73,24 +73,18 @@ class Object:
             elif STATE_ORDER[v.state] > STATE_ORDER[cur.state]:
                 byid[v.uuid] = v
         versions = sorted(byid.values(), key=lambda v: v.cmp_key())
-        # prune: find newest complete version; drop older non-uploading ones
-        # and all aborted ones
-        newest_complete = None
-        for v in versions:
+        # prune (object_table.rs:513-526): drop everything strictly older
+        # than the newest complete version; keep the rest — INCLUDING
+        # aborted versions, which persist as terminal CRDT tombstones so a
+        # replica that missed the abort converges instead of resurrecting
+        # the upload via anti-entropy (the cascade handles data cleanup).
+        last_complete_idx = None
+        for i, v in enumerate(versions):
             if v.is_complete_or_dm():
-                newest_complete = v
-        out = []
-        for v in versions:
-            if v.state == "aborted":
-                continue  # aborted versions vanish (cascade deletes them)
-            if (
-                newest_complete is not None
-                and v.cmp_key() < newest_complete.cmp_key()
-                and v.state == "complete"
-            ):
-                continue
-            out.append(v)
-        self.versions = out
+                last_complete_idx = i
+        if last_complete_idx is not None:
+            versions = versions[last_complete_idx:]
+        self.versions = versions
 
     def last_complete(self) -> ObjectVersion | None:
         last = None
@@ -166,9 +160,15 @@ class ObjectTable(TableSchema):
             return
         from .version_table import Version
 
-        new_uuids = {v.uuid for v in new.versions} if new is not None else set()
+        # a version's data is deleted when it disappeared from the merged
+        # list OR it newly transitioned to aborted (object_table.rs:571-600)
+        new_by_id = {v.uuid: v for v in new.versions} if new is not None else {}
         for v in old.versions if old is not None else []:
-            if v.uuid not in new_uuids and v.data.get("t") != "delete_marker":
+            nv = new_by_id.get(v.uuid)
+            delete_version = (
+                nv is None or (nv.state == "aborted" and v.state != "aborted")
+            )
+            if delete_version and v.data.get("t") != "delete_marker":
                 # enqueue deletion (async local insert; the queue worker
                 # fans it out with quorum)
                 self.version_table.queue_insert(

@@ -13,8 +13,13 @@ from the `cryptography` package primitives (NOT a port):
      one ChaCha20-Poly1305 key per direction; forward secrecy from the
      ephemeral DH.
   3. Over the encrypted channel, each side sends its static ed25519 public
-     key (= node id) and a signature over the handshake transcript,
-     proving node identity.  The client may pin an expected peer id.
+     key (= node id) and a signature over (role tag || its own static key
+     || the handshake transcript), proving node identity.  Binding the
+     signer's role and static key into the signed message (as the
+     reference's secret-handshake does) prevents reflection: a peer that
+     only knows the network key cannot echo our own auth frame back as its
+     identity proof — the role tag differs per side, and an identical
+     frame is rejected outright.  The client may pin an expected peer id.
 
 Frames after the handshake: [u32 len][ChaCha20-Poly1305 ciphertext], nonce
 = 4-byte direction tag + 8-byte counter.
@@ -38,7 +43,7 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import (
 )
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
-VERSION_TAG = b"grg_tpu0"  # protocol version gate
+VERSION_TAG = b"grg_tpu1"  # protocol version gate (1: role-bound auth sigs)
 MAX_FRAME = 20 * 1024
 
 
@@ -163,15 +168,19 @@ async def handshake(
     sk = Ed25519PrivateKey.from_private_bytes(node_privkey_raw)
     my_id = sk.public_key().public_bytes_raw()
     transcript = info + eph_pub + peer_eph if is_server else info + peer_eph + eph_pub
-    sig = sk.sign(b"garage-tpu-auth" + transcript)
-    box.send_frame(my_id + sig)
+    my_role, peer_role = (b"server", b"client") if is_server else (b"client", b"server")
+    sig = sk.sign(b"garage-tpu-auth" + my_role + my_id + transcript)
+    my_auth = my_id + sig
+    box.send_frame(my_auth)
     await box.drain()
 
     peer_auth = await box.recv_frame()
+    if hmac_mod.compare_digest(peer_auth, my_auth):
+        raise HandshakeError("peer echoed our own auth frame (reflection)")
     peer_id, peer_sig = peer_auth[:32], peer_auth[32:]
     try:
         Ed25519PublicKey.from_public_bytes(peer_id).verify(
-            peer_sig, b"garage-tpu-auth" + transcript
+            peer_sig, b"garage-tpu-auth" + peer_role + peer_id + transcript
         )
     except Exception as e:
         raise HandshakeError(f"peer identity signature invalid: {e}") from e

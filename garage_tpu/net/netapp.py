@@ -44,12 +44,14 @@ class Endpoint:
         order_tag=None,
     ) -> Resp:
         from ..utils.metrics import registry
+        from ..utils.tracing import span
 
-        with registry.timer("rpc_request_duration", (("endpoint", self.path),)):
-            return await self.netapp.call(
-                target, self.path, Req(msg, stream=stream, order_tag=order_tag),
-                prio=prio, timeout=timeout,
-            )
+        with span("rpc:" + self.path, to=target.hex()[:16]):
+            with registry.timer("rpc_request_duration", (("endpoint", self.path),)):
+                return await self.netapp.call(
+                    target, self.path, Req(msg, stream=stream, order_tag=order_tag),
+                    prio=prio, timeout=timeout,
+                )
 
 
 class NetApp:
@@ -66,6 +68,9 @@ class NetApp:
         self._connecting: dict[bytes, asyncio.Lock] = {}
         self.server: asyncio.AbstractServer | None = None
         self.bind_addr: tuple[str, int] | None = None
+        # fault-injection seam (chaos tests): peers in this set are
+        # unreachable — calls fail fast, like a network partition
+        self.blocked_peers: set[bytes] = set()
         self.on_connected: Callable[[bytes, bool], None] | None = None
         self.on_disconnected: Callable[[bytes], None] | None = None
 
@@ -81,9 +86,11 @@ class NetApp:
         if ep is None or ep.handler is None:
             raise RpcError(f"no handler for endpoint {path!r}")
         from ..utils.metrics import registry
+        from ..utils.tracing import span
 
-        with registry.timer("rpc_handle_duration", (("endpoint", path),)):
-            return await ep.handler(from_id, req)
+        with span("rpc-handle:" + path, from_=from_id.hex()[:16]):
+            with registry.timer("rpc_handle_duration", (("endpoint", path),)):
+                return await ep.handler(from_id, req)
 
     # --- connections ---------------------------------------------------------
 
@@ -173,6 +180,8 @@ class NetApp:
         if target == self.id:
             # local shortcut (reference calls local handlers directly too)
             return await self._dispatch(path, self.id, req)
+        if target in self.blocked_peers:
+            raise RpcError(f"peer {target.hex()[:16]} unreachable (partition)")
         conn = self.conns.get(target)
         if conn is None:
             raise RpcError(f"not connected to {target.hex()[:16]}")

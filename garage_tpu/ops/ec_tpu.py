@@ -10,21 +10,30 @@ GF(2^8) multiplication by a constant is GF(2)-linear on the operand's bits
     out_bits[b, i, s] = ( B @ in_bits )[b, i, s]  mod 2
 
 i.e. ONE dense matmul over the bit-unpacked shards, batched over blocks —
-exactly the shape the MXU wants (a skinny (8r x 8q) x (8q x B*S) product
-with an enormous inner dimension).  XOR becomes addition because we only
+exactly the shape the MXU wants.  XOR becomes addition because we only
 need the low bit of the integer accumulation.
 
-- Operands are 0/1 in bfloat16: bf16 x bf16 -> f32 accumulation is native
-  MXU; sums are <= 8q <= 2048 so f32 (and bf16 inputs) are exact.
-- Unpack (uint8 -> 8 bit-planes) and pack are elementwise shifts XLA fuses
-  around the matmul; `& 1` realizes the mod-2.
-- The coding matrix is a traced argument: encode, decode and every repair
-  erasure-pattern reuse ONE compiled kernel per data shape, so batched
-  resync (10k blocks / dispatch) never recompiles.
+Two data paths share that math:
 
-The same kernel handles encode (B = bitmatrix of the Cauchy parity matrix)
-and reconstruction (B = bitmatrix of gf.reconstruction_matrix), checked
-bit-for-bit against the numpy LUT reference in tests/test_ec.py.
+1. `gf_bitmatmul` — pure-XLA einsum.  Portable (CPU/TPU), but XLA
+   materializes the bit-unpacked operand in HBM: bf16 bit-planes are a 16x
+   traffic blowup over the uint8 shards, capping throughput far below the
+   HBM roofline.  Kept as the fallback and the CPU path.
+
+2. `gf_bitmatmul_pallas` — fused Pallas kernel: each grid step DMAs a
+   (q, TS) uint8 shard tile into VMEM, unpacks to bit-planes *in VMEM*,
+   runs the (8r x 8q) @ (8q x TS) product on the MXU (int8 x int8 -> int32
+   — 2x MXU rate on v5e — or bf16), takes the low bit, and re-packs bits
+   to bytes with a second tiny matmul, so HBM sees only the uint8 shards
+   in and the uint8 parity out (1 + r/q of input bytes — the roofline).
+   Bit-packing via matmul keeps every intermediate 2-D (Mosaic-friendly):
+   pack matrix P[i, 8i+t] = 2^t, with t=7 encoded as int8 -128 and
+   recovered by the wrapping int32 -> uint8 cast.
+
+The coding matrix is a traced argument: encode, decode and every repair
+erasure-pattern reuse ONE compiled kernel per data shape, so batched
+resync (10k blocks / dispatch) never recompiles.  Checked bit-for-bit
+against the numpy LUT reference in tests/test_ec.py.
 """
 
 from __future__ import annotations
@@ -43,11 +52,9 @@ def _jax():
 
 
 def gf_bitmatmul(bitmat, x):
-    """The (traceable) bit-plane coding body — THE GF(2^8) data-path kernel.
+    """Pure-XLA bit-plane coding body (portable fallback).
 
     bitmat: (8r, 8q) 0/1 bf16;  x: (B, q, S) uint8  ->  (B, r, S) uint8.
-    Shared by EcTpu and the fused scrub/repair pipeline so there is exactly
-    one copy of the bit-exact kernel.
     """
     import jax.numpy as jnp
 
@@ -65,14 +72,128 @@ def gf_bitmatmul(bitmat, x):
     return (out_bits * weights).sum(axis=2, dtype=jnp.uint8)
 
 
+# --- fused Pallas kernel -----------------------------------------------------
+
+def _pick_tile(s: int) -> int:
+    """Largest lane-tile (multiple of 128) dividing S, capped at 8192."""
+    for ts in (8192, 4096, 2048, 1024, 512, 256, 128):
+        if s % ts == 0:
+            return ts
+    return 0  # S not a multiple of 128: caller must use the einsum path
+
+
+def _plane_major_cols(bitmat, q: int):
+    """Permute (8r, 8q) standard-layout columns (8j+a) to plane-major (a*q+j)
+    so the kernel can build its RHS by concatenating 8 shift-planes."""
+    r8 = bitmat.shape[0]
+    return bitmat.reshape(r8, q, 8).transpose(0, 2, 1).reshape(r8, 8 * q)
+
+
+def _pack_matrix(r: int) -> np.ndarray:
+    """(r, 8r) int8 bit-pack matrix: P[i, 8i+t] = 2^t, t=7 as -128 (two's
+    complement; the wrapping int32 -> uint8 cast restores bit 7)."""
+    p = np.zeros((r, 8 * r), dtype=np.int8)
+    for i in range(r):
+        for t in range(8):
+            p[i, 8 * i + t] = -128 if t == 7 else (1 << t)
+    return p
+
+
+def gf_bitmatmul_pallas(bitmat, x, *, dot_dtype: str = "int8", interpret: bool = False):
+    """Fused unpack -> MXU matmul -> pack kernel.
+
+    bitmat: (8r, 8q) 0/1 integer array (standard gf.bitmatrix_of layout);
+    x: (B, q, S) uint8 with S a multiple of 128  ->  (B, r, S) uint8.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, q, s = x.shape
+    r8, q8 = bitmat.shape
+    assert q8 == 8 * q, (bitmat.shape, x.shape)
+    r = r8 // 8
+    ts = _pick_tile(s)
+    assert ts, f"shard size {s} not a multiple of 128; use the einsum path"
+
+    mxu_dtype = jnp.int8 if dot_dtype == "int8" else jnp.bfloat16
+    acc_dtype = jnp.int32 if dot_dtype == "int8" else jnp.float32
+    w = _plane_major_cols(bitmat, q).astype(mxu_dtype)
+    pack = jnp.asarray(_pack_matrix(r), dtype=jnp.int8)
+
+    def kernel(w_ref, p_ref, x_ref, o_ref):
+        xi = x_ref[0].astype(jnp.int32)  # (q, TS)
+        bits = jnp.concatenate(
+            [(xi >> t) & 1 for t in range(8)], axis=0
+        ).astype(mxu_dtype)  # (8q, TS), plane-major rows
+        acc = jax.lax.dot_general(
+            w_ref[:], bits,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+        )  # (8r, TS)
+        obits = (acc.astype(jnp.int32) & 1).astype(jnp.int8)
+        packed = jax.lax.dot_general(
+            p_ref[:], obits,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (r, TS), values in [-128, 127]
+        o_ref[0] = packed.astype(jnp.uint8)  # wrapping cast restores bit 7
+
+    return pl.pallas_call(
+        kernel,
+        grid=(b, s // ts),
+        in_specs=[
+            pl.BlockSpec((r8, q8), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, r8), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, q, ts), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, r, ts), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, r, s), jnp.uint8),
+        interpret=interpret,
+    )(w, pack, x)
+
+
+# --- dispatch ---------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def ec_apply_fn(platform: str | None = None, impl: str | None = None):
+    """Jitted `fn(bitmat_uint8, x_uint8) -> out_uint8`, cached per
+    (platform, impl).  impl: None = auto (Pallas on TPU, einsum elsewhere),
+    or one of "einsum" / "pallas_int8" / "pallas_bf16"."""
+    jax = _jax()
+    import jax.numpy as jnp
+
+    plat = platform or jax.default_backend()
+    if impl is None:
+        impl = "pallas_int8" if plat not in ("cpu",) else "einsum"
+
+    if impl == "einsum":
+        def body(bitmat, x):
+            return gf_bitmatmul(bitmat.astype(jnp.bfloat16), x)
+    elif impl in ("pallas_int8", "pallas_bf16"):
+        dd = "int8" if impl == "pallas_int8" else "bf16"
+        interp = plat == "cpu"  # interpreter mode for CPU tests
+
+        def body(bitmat, x):
+            if _pick_tile(x.shape[-1]) == 0:
+                return gf_bitmatmul(bitmat.astype(jnp.bfloat16), x)
+            return gf_bitmatmul_pallas(bitmat, x, dot_dtype=dd, interpret=interp)
+    else:
+        raise ValueError(f"unknown impl {impl!r}")
+
+    kwargs = {"backend": platform} if platform else {}
+    return jax.jit(body, **kwargs)
+
+
+# legacy alias used by the fused pipeline (portable einsum body)
 @functools.lru_cache(maxsize=None)
 def _apply_fn(platform: str | None):
-    """Jitted gf_bitmatmul (cached per platform)."""
     jax = _jax()
 
-    kwargs = {}
-    if platform:
-        kwargs["backend"] = platform
+    kwargs = {"backend": platform} if platform else {}
     return jax.jit(gf_bitmatmul, **kwargs)
 
 
@@ -81,30 +202,43 @@ class EcTpu:
 
     Host API takes/returns numpy uint8 arrays shaped (B, shards, S); the
     BlockCodec layer (garage_tpu/block/codec/ec.py) handles bytes<->array
-    marshalling and dispatch batching.
+    marshalling and dispatch batching.  Uses the fused Pallas kernel on
+    TPU backends with a transparent one-time fallback to the portable
+    einsum path if the Pallas lowering is unavailable.
     """
 
     def __init__(self, k: int, m: int, platform: str | None = None):
         self.k, self.m = k, m
         self.platform = platform
-        enc_bits = gf.bitmatrix_of(gf.cauchy_parity_matrix(k, m))
-        self._enc_bitmat = self._to_dev(enc_bits)
+        self._impl: str | None = None  # auto until first failure
+        self._enc_bitmat = self._to_dev(gf.bitmatrix_of(gf.cauchy_parity_matrix(k, m)))
         self._recon_cache: dict[tuple[tuple[int, ...], tuple[int, ...]], object] = {}
 
     def _to_dev(self, bitmat_np: np.ndarray):
         import jax.numpy as jnp
 
-        arr = jnp.asarray(bitmat_np, dtype=jnp.bfloat16)
+        arr = jnp.asarray(bitmat_np, dtype=jnp.uint8)
         if self.platform:
             jax = _jax()
             arr = jax.device_put(arr, jax.devices(self.platform)[0])
         return arr
 
+    def _apply(self, bitmat, x: np.ndarray) -> np.ndarray:
+        try:
+            fn = ec_apply_fn(self.platform, self._impl)
+            return np.asarray(fn(bitmat, x))
+        except Exception:
+            if self._impl == "einsum":
+                raise
+            # Pallas path unavailable on this backend: pin the fallback.
+            self._impl = "einsum"
+            fn = ec_apply_fn(self.platform, self._impl)
+            return np.asarray(fn(bitmat, x))
+
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(B, k, S) data shards -> (B, m, S) parity shards."""
         assert data.ndim == 3 and data.shape[1] == self.k and data.dtype == np.uint8
-        out = _apply_fn(self.platform)(self._enc_bitmat, data)
-        return np.asarray(out)
+        return self._apply(self._enc_bitmat, data)
 
     def reconstruct(
         self, shards: np.ndarray, present: list[int], want: list[int]
@@ -118,9 +252,8 @@ class EcTpu:
             rmat = gf.reconstruction_matrix(self.k, self.m, list(key[0]), list(want))
             bitmat = self._to_dev(gf.bitmatrix_of(rmat))
             self._recon_cache[key] = bitmat
-        out = _apply_fn(self.platform)(bitmat, shards[:, : self.k, :])
-        return np.asarray(out)
+        return self._apply(bitmat, shards[:, : self.k, :])
 
     def encode_jit(self):
         """(bitmat, fn) for building fused pipelines (bench / graft entry)."""
-        return self._enc_bitmat, _apply_fn(self.platform)
+        return self._enc_bitmat, ec_apply_fn(self.platform, self._impl)

@@ -178,20 +178,27 @@ class RpcHelper:
             for n in s:
                 if n not in all_nodes:
                     all_nodes.append(n)
+        # a write set smaller than the configured quorum can never deliver
+        # the promised durability — fail loudly instead of silently
+        # lowering the bar (reference rpc_helper.rs errors here too)
+        for i, s in enumerate(write_sets):
+            if len(s) < quorum:
+                raise Quorum(
+                    quorum,
+                    0,
+                    [f"write set {i} has only {len(s)} nodes (< quorum {quorum})"],
+                )
         set_success = [0] * len(write_sets)
         set_failed = [0] * len(write_sets)
         errors: list[str] = []
         done_ev = asyncio.Event()
 
         def sets_satisfied() -> bool:
-            return all(
-                s >= min(quorum, len(write_sets[i]))
-                for i, s in enumerate(set_success)
-            )
+            return all(s >= quorum for s in set_success)
 
         def sets_hopeless() -> bool:
             return any(
-                len(write_sets[i]) - set_failed[i] < min(quorum, len(write_sets[i]))
+                len(write_sets[i]) - set_failed[i] < quorum
                 for i in range(len(write_sets))
             )
 

@@ -102,6 +102,7 @@ class System:
         metadata_dir: str | None = None,
         data_dirs: list[str] | None = None,
         public_addr: tuple[str, int] | None = None,
+        discovery: list | None = None,
     ):
         self.netapp = netapp
         self.id = netapp.id
@@ -110,6 +111,9 @@ class System:
         self.peer_persister = peer_persister
         self.metadata_dir = metadata_dir
         self.data_dirs = data_dirs or []
+        # external publishers (Consul/Kubernetes, rpc/discovery.py)
+        self.discovery = discovery or []
+        self.public_addr = public_addr
         persisted = peer_persister.load() if peer_persister else None
         known = list(bootstrap or [])
         if persisted:
@@ -140,6 +144,11 @@ class System:
             try:
                 await t
             except (asyncio.CancelledError, Exception):
+                pass
+        for d in self.discovery:
+            try:
+                await d.close()
+            except Exception:  # noqa: BLE001
                 pass
         await self.peering.stop()
 
@@ -237,9 +246,34 @@ class System:
                         if p.addr is not None
                     ]
                     self.peer_persister.save(PersistedPeers(peers))
+                await self._external_discovery()
             except Exception:  # noqa: BLE001
                 logger.exception("discovery loop error")
             await asyncio.sleep(DISCOVERY_INTERVAL)
+
+    async def _external_discovery(self) -> None:
+        """Publish this node to + learn peers from external publishers
+        (reference system.rs discovery via consul.rs / kubernetes.rs)."""
+        if not self.discovery:
+            return
+        my_addr = self.public_addr or self.netapp.bind_addr
+        for d in self.discovery:
+            try:
+                if my_addr is not None:
+                    await d.publish(self.id, my_addr)
+                for node_id, addr in await d.get_nodes():
+                    if node_id == self.id or self.netapp.is_connected(node_id):
+                        continue
+                    try:
+                        await self.netapp.connect(addr, node_id)
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug(
+                            "discovered peer %s @ %s unreachable: %r",
+                            node_id.hex()[:8], addr, e,
+                        )
+            except Exception as e:  # noqa: BLE001
+                logger.warning("external discovery (%s) failed: %r",
+                               type(d).__name__, e)
 
     # --- health --------------------------------------------------------------
 

@@ -151,6 +151,38 @@ class Table:
         ents = [e for _sk, e in out if self.schema.matches_filter(e, filt)]
         return ents[:limit]
 
+    async def get_all_local(self, filt: Any = None, limit: int = 100_000) -> list:
+        """Enumerate ALL local entries across partitions.  Correct for
+        full-copy tables (every node holds everything) — the control-plane
+        list operations (buckets, keys, aliases) use this; a per-partition
+        get_range cannot enumerate tables whose partition key is the
+        entry id itself."""
+        out = []
+        for _k, v in self.data.store.iter_range():
+            ent = self.data.decode(v)
+            if self.schema.matches_filter(ent, filt):
+                out.append(ent)
+                if len(out) >= limit:
+                    break
+        return out
+
+    async def get_local(self, pk: bytes, sk: bytes):
+        """Read THIS replica's copy only — no quorum, no read-repair.
+        For replica-side handlers (e.g. K2V polls) where this node is
+        itself one of the replicas being polled."""
+        v = self.data.read_entry(pk, sk)
+        return self.data.decode(v) if v is not None else None
+
+    async def get_range_local(
+        self,
+        pk: bytes,
+        start_sk: bytes | None = None,
+        filt: Any = None,
+        limit: int = 1000,
+    ) -> list:
+        vals = self.data.read_range(pk, start_sk, filt, limit, False)
+        return [self.data.decode(v) for v in vals]
+
     async def _repair(self, entries: list, nodes: list[bytes]) -> None:
         try:
             values = [pack(self.schema.encode_entry(e)) for e in entries]

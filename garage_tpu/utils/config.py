@@ -53,6 +53,29 @@ class AdminConfig:
 
 
 @dataclass
+class ConsulDiscoveryConfig:
+    """Reference src/util/config.rs ConsulDiscoveryConfig / consul.rs."""
+
+    consul_http_addr: str = "http://127.0.0.1:8500"
+    service_name: str = "garage-tpu"
+    api: str = "catalog"  # "catalog" | "agent"
+    token: str | None = None
+    tags: list[str] = field(default_factory=list)
+    meta: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class KubernetesDiscoveryConfig:
+    """Reference src/util/config.rs KubernetesDiscoveryConfig / kubernetes.rs."""
+
+    namespace: str = "default"
+    service_name: str = "garage-tpu"
+    skip_crd: bool = False
+    api_server: str | None = None  # None = in-cluster default
+    token: str | None = None  # None = mounted service account
+
+
+@dataclass
 class TpuConfig:
     """Rebuild-specific: the TPU compute plane used by the EC block codec and
     batched scrub hashing (no analog in the reference)."""
@@ -99,6 +122,8 @@ class Config:
     s3_web: WebConfig = field(default_factory=WebConfig)
     admin: AdminConfig = field(default_factory=AdminConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
+    consul_discovery: ConsulDiscoveryConfig | None = None
+    kubernetes_discovery: KubernetesDiscoveryConfig | None = None
 
     # --- derived -----------------------------------------------------------
 
@@ -192,6 +217,14 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             cfg.admin = AdminConfig(**_known(v, AdminConfig))
         elif k == "tpu":
             cfg.tpu = TpuConfig(**_known(v, TpuConfig))
+        elif k == "consul_discovery":
+            cfg.consul_discovery = ConsulDiscoveryConfig(
+                **_known(v, ConsulDiscoveryConfig)
+            )
+        elif k == "kubernetes_discovery":
+            cfg.kubernetes_discovery = KubernetesDiscoveryConfig(
+                **_known(v, KubernetesDiscoveryConfig)
+            )
         # unknown sections are ignored (forward compat)
     # resolve secrets
     cfg.rpc_secret = _get_secret(

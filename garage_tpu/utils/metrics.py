@@ -2,9 +2,19 @@
 meters exported via the admin Prometheus endpoint, src/util/metrics.rs +
 doc/book/reference-manual/monitoring.md).
 
-Counters and duration summaries keyed (name, labels); rendered into
-Prometheus exposition text by the admin API.  No external deps, negligible
-hot-path cost (a dict update per observation).
+Three instrument kinds, rendered into Prometheus exposition text by the
+admin API, no external deps:
+
+  - counters                  incr(name, labels)
+  - latency histograms        observe()/timer() — log2-spaced buckets from
+                              0.25 ms to ~8 s plus +Inf, so p99 is visible
+                              (BASELINE's S3 target is a p99), rendered in
+                              standard `_bucket{le=…}` form
+  - gauges                    set_gauge() for pushed values, or
+                              register_gauge(name, labels, fn) for values
+                              polled at scrape time (queue lengths,
+                              backlogs — reference src/block/metrics.rs,
+                              src/table/metrics.rs pattern)
 """
 
 from __future__ import annotations
@@ -12,11 +22,19 @@ from __future__ import annotations
 import time
 from collections import defaultdict
 
+# 0.25 ms .. 8192 ms, log2-spaced (16 finite buckets)
+BUCKETS = [0.00025 * (2 ** i) for i in range(16)]
+
 
 class Metrics:
     def __init__(self) -> None:
         self.counters: dict[tuple, float] = defaultdict(float)
-        self.durations: dict[tuple, list] = defaultdict(lambda: [0, 0.0])
+        # (name, labels) -> [count, sum_seconds, bucket_counts]
+        self.durations: dict[tuple, list] = defaultdict(
+            lambda: [0, 0.0, [0] * (len(BUCKETS) + 1)]
+        )
+        self.gauges: dict[tuple, float] = {}
+        self._gauge_fns: dict[tuple, object] = {}
 
     def incr(self, name: str, labels: tuple = (), by: float = 1) -> None:
         self.counters[(name, labels)] += by
@@ -25,17 +43,60 @@ class Metrics:
         d = self.durations[(name, labels)]
         d[0] += 1
         d[1] += seconds
+        for i, ub in enumerate(BUCKETS):
+            if seconds <= ub:
+                d[2][i] += 1
+                return
+        d[2][-1] += 1
 
     def timer(self, name: str, labels: tuple = ()):
         return _Timer(self, name, labels)
+
+    def set_gauge(self, name: str, labels: tuple, value: float) -> None:
+        self.gauges[(name, labels)] = value
+
+    def register_gauge(self, name: str, labels: tuple, fn) -> None:
+        """fn() is called at scrape time; exceptions drop the sample."""
+        self._gauge_fns[(name, labels)] = fn
+
+    def unregister_gauge(self, name: str, labels: tuple = ()) -> None:
+        self._gauge_fns.pop((name, labels), None)
+        self.gauges.pop((name, labels), None)
+
+    def quantile(self, name: str, labels: tuple, q: float) -> float | None:
+        """Approximate quantile from the histogram (upper bucket bound)."""
+        d = self.durations.get((name, labels))
+        if d is None or d[0] == 0:
+            return None
+        target = q * d[0]
+        acc = 0
+        for i, c in enumerate(d[2]):
+            acc += c
+            if acc >= target:
+                return BUCKETS[i] if i < len(BUCKETS) else float("inf")
+        return float("inf")
 
     def render(self) -> list[str]:
         lines = []
         for (name, labels), v in sorted(self.counters.items()):
             lines.append(f"{name}{_fmt(labels)} {v:g}")
-        for (name, labels), (n, total) in sorted(self.durations.items()):
+        for (name, labels), (n, total, buckets) in sorted(self.durations.items()):
+            acc = 0
+            for i, c in enumerate(buckets[:-1]):
+                acc += c
+                le = (("le", f"{BUCKETS[i]:g}"),)
+                lines.append(f"{name}_bucket{_fmt(labels + le)} {acc}")
+            lines.append(f'{name}_bucket{_fmt(labels + (("le", "+Inf"),))} {n}')
             lines.append(f"{name}_count{_fmt(labels)} {n}")
             lines.append(f"{name}_seconds_total{_fmt(labels)} {total:.6f}")
+        gauges = dict(self.gauges)
+        for (name, labels), fn in self._gauge_fns.items():
+            try:
+                gauges[(name, labels)] = float(fn())
+            except Exception:  # noqa: BLE001 — a dead gauge must not kill scrape
+                continue
+        for (name, labels), v in sorted(gauges.items()):
+            lines.append(f"{name}{_fmt(labels)} {v:g}")
         return lines
 
 

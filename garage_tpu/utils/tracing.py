@@ -1,0 +1,193 @@
+"""Span tracing with OTLP/HTTP export (reference: OpenTelemetry spans
+around every RPC/API/table op, exported via OTLP when `admin.trace_sink`
+is configured — src/garage/tracing_setup.rs:13-37, src/rpc/rpc_helper.rs:172-217).
+
+Design: a contextvar carries the current span, so `with span("name"):`
+nests correctly across asyncio task boundaries (contextvars propagate
+into tasks).  Finished spans buffer in memory and a background flusher
+POSTs them as OTLP/HTTP JSON (`/v1/traces`) to the sink.  When no sink is
+configured the API is a near-zero-cost no-op — the hot paths stay hot.
+
+Span ids follow W3C sizes: 16-byte trace id, 8-byte span id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import logging
+import os
+import time
+from contextlib import contextmanager
+
+logger = logging.getLogger("garage.tracing")
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "garage_current_span", default=None
+)
+
+MAX_BUFFER = 8192
+FLUSH_INTERVAL = 3.0
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id",
+        "start_ns", "end_ns", "attrs", "ok",
+    )
+
+    def __init__(self, name: str, parent: "Span | None", attrs: dict):
+        self.name = name
+        self.trace_id = parent.trace_id if parent else os.urandom(16)
+        self.span_id = os.urandom(8)
+        self.parent_id = parent.span_id if parent else None
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attrs = attrs
+        self.ok = True
+
+
+class Tracer:
+    def __init__(self):
+        self.sink: str | None = None
+        self.service_name = "garage-tpu"
+        self._buf: list[Span] = []
+        self._task: asyncio.Task | None = None
+        self._session = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.sink is not None
+
+    def configure(self, sink: str | None, service_name: str = "garage-tpu") -> None:
+        self.sink = sink
+        self.service_name = service_name
+        if sink and self._task is None:
+            try:
+                self._task = asyncio.get_event_loop().create_task(self._flusher())
+            except RuntimeError:
+                pass  # no loop yet; caller may start() later
+
+    async def start(self) -> None:
+        if self.sink and (self._task is None or self._task.done()):
+            self._task = asyncio.get_event_loop().create_task(self._flusher())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        await self._flush()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Context manager for a traced operation.  Cheap no-op (no span
+        object at all) when tracing is off."""
+        if not self.enabled:
+            yield None
+            return
+        parent = _current.get()
+        s = Span(name, parent, attrs)
+        token = _current.set(s)
+        try:
+            yield s
+        except BaseException:
+            s.ok = False
+            raise
+        finally:
+            _current.reset(token)
+            s.end_ns = time.time_ns()
+            if len(self._buf) < MAX_BUFFER:
+                self._buf.append(s)
+
+    def current(self) -> Span | None:
+        return _current.get()
+
+    # --- export ---------------------------------------------------------------
+
+    async def _flusher(self) -> None:
+        while True:
+            await asyncio.sleep(FLUSH_INTERVAL)
+            try:
+                await self._flush()
+            except Exception as e:  # noqa: BLE001 — tracing must never kill the daemon
+                logger.debug("trace export failed: %r", e)
+
+    async def _flush(self) -> None:
+        if not self._buf or not self.sink:
+            return
+        spans, self._buf = self._buf, []
+        payload = self._otlp(spans)
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        url = self.sink.rstrip("/") + "/v1/traces"
+        async with self._session.post(
+            url, json=payload, timeout=aiohttp.ClientTimeout(total=10)
+        ) as resp:
+            if resp.status >= 400:
+                logger.debug("trace sink returned %d", resp.status)
+
+    def _otlp(self, spans: list[Span]) -> dict:
+        """OTLP/HTTP JSON encoding (trace ids hex, times in ns strings)."""
+
+        def attr(k, v):
+            if isinstance(v, bool):
+                val = {"boolValue": v}
+            elif isinstance(v, int):
+                val = {"intValue": str(v)}
+            elif isinstance(v, float):
+                val = {"doubleValue": v}
+            else:
+                val = {"stringValue": str(v)}
+            return {"key": k, "value": val}
+
+        return {
+            "resourceSpans": [
+                {
+                    "resource": {
+                        "attributes": [attr("service.name", self.service_name)]
+                    },
+                    "scopeSpans": [
+                        {
+                            "scope": {"name": "garage-tpu"},
+                            "spans": [
+                                {
+                                    "traceId": s.trace_id.hex(),
+                                    "spanId": s.span_id.hex(),
+                                    **(
+                                        {"parentSpanId": s.parent_id.hex()}
+                                        if s.parent_id
+                                        else {}
+                                    ),
+                                    "name": s.name,
+                                    "kind": 1,
+                                    "startTimeUnixNano": str(s.start_ns),
+                                    "endTimeUnixNano": str(s.end_ns),
+                                    "attributes": [
+                                        attr(k, v) for k, v in s.attrs.items()
+                                    ],
+                                    "status": {"code": 1 if s.ok else 2},
+                                }
+                                for s in spans
+                            ],
+                        }
+                    ],
+                }
+            ]
+        }
+
+
+# process-wide tracer (configured by the daemon from admin.trace_sink)
+tracer = Tracer()
+
+
+def span(name: str, **attrs):
+    return tracer.span(name, **attrs)

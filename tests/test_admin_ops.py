@@ -1,0 +1,304 @@
+"""Operator-parity tests: layout config/history/skip-dead-nodes, block
+{list-errors,info,retry-now,purge}, repair {versions,mpu,block-refs,scrub},
+admin-API bucket/key CRUD breadth (reference src/garage/cli/structs.rs,
+src/api/admin/bucket.rs, key.rs)."""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_s3_api import make_client, make_daemon, teardown  # noqa: E402
+
+from garage_tpu.cli.admin_rpc import AdminRpcHandler  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def rpc(handler, op, args=None):
+    from garage_tpu.net.message import Req
+
+    resp = await handler._handle(b"\x00" * 32, Req([op, args or {}]))
+    return resp.body
+
+
+def test_layout_config_history_skip_dead(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        adm = AdminRpcHandler(garage)
+        try:
+            # config: stage zone redundancy
+            out = await rpc(adm, "layout-config", {"zone_redundancy": 1})
+            assert "staged" in out
+            hist = await rpc(adm, "layout-history")
+            assert hist["current_version"] >= 1
+            assert hist["versions"][-1]["status"] == "current"
+            me = garage.node_id.hex()
+            assert hist["trackers"][me]["ack"] == hist["current_version"]
+
+            # skip-dead-nodes: a vanished node's trackers get forced forward
+            from garage_tpu.net.handshake import gen_node_key, node_id_of
+            from garage_tpu.rpc.layout.types import NodeRole
+
+            ghost = node_id_of(gen_node_key())
+            garage.layout_manager.stage_role(
+                ghost, NodeRole(zone="dc-ghost", capacity=10**12)
+            )
+            garage.layout_manager.apply_staged()
+            cur = garage.layout_manager.history.current().version
+            res = await rpc(
+                adm, "layout-skip-dead-nodes",
+                {"version": cur, "allow_missing_data": True},
+            )
+            assert ghost.hex() in res["skipped_nodes"]
+            h = garage.layout_manager.history
+            assert h.ack.get(ghost) == cur
+            assert h.sync.get(ghost) == cur
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_block_ops(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        adm = AdminRpcHandler(garage)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("blk")
+            await client.put_object("blk", "obj", os.urandom(9_000))
+            bm = garage.block_manager
+            some_hash = next(h for h, _v in bm.rc.tree.iter_range())
+
+            # info: refcounted, stored, resolvable by prefix
+            info = await rpc(adm, "block-info", {"hash": some_hash.hex()[:12]})
+            assert info["hash"] == some_hash.hex()
+            assert info["refcount"] >= 1 and info["needed"]
+            assert info["stored_locally"]
+            assert info["refs"] and info["refs"][0]["key"] == "obj"
+
+            # list-errors starts empty; plant an error and see it
+            assert await rpc(adm, "block-list-errors") == []
+            from garage_tpu.utils.serde import pack
+            from garage_tpu.utils.time_util import now_msec
+
+            bm.resync.errors.insert(
+                some_hash, pack([3, now_msec() + 60_000])
+            )
+            errs = await rpc(adm, "block-list-errors")
+            assert len(errs) == 1 and errs[0]["failures"] == 3
+            assert errs[0]["next_try_in_secs"] > 0
+
+            # retry-now clears the backoff and requeues
+            out = await rpc(adm, "block-retry-now", {"all": True})
+            assert "1 blocks" in out
+            assert await rpc(adm, "block-list-errors") == []
+
+            # purge requires confirmation, then tombstones the references
+            with pytest.raises(ValueError):
+                await rpc(adm, "block-purge", {"hash": some_hash.hex()})
+            res = await rpc(
+                adm, "block-purge", {"hash": some_hash.hex(), "yes": True}
+            )
+            assert res["versions_deleted"] >= 1
+            from garage_tpu.api.s3.client import S3Error
+
+            with pytest.raises(S3Error):
+                await client.get_object("blk", "obj")
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_metadata_repairs(tmp_path):
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        adm = AdminRpcHandler(garage)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("rep")
+            await client.put_object("rep", "keep", os.urandom(9_000))
+
+            from garage_tpu.model.repair import (
+                BlockRefRepairWorker,
+                MpuRepairWorker,
+                VersionRepairWorker,
+            )
+            from garage_tpu.model.s3.block_ref_table import BlockRef
+            from garage_tpu.model.s3.mpu_table import MultipartUpload
+            from garage_tpu.model.s3.version_table import Version
+            from garage_tpu.utils.background import WorkerState
+            from garage_tpu.utils.data import gen_uuid
+            from garage_tpu.utils.time_util import now_msec
+
+            # plant a dangling version, a dangling mpu, a dangling block ref
+            dangling_vid = gen_uuid()
+            await garage.version_table.insert(
+                Version(dangling_vid, b"B" * 32, "ghost-key")
+            )
+            ghost_mpu = MultipartUpload(
+                gen_uuid(), b"B" * 32, "ghost-mpu", timestamp=now_msec()
+            )
+            await garage.mpu_table.insert(ghost_mpu)
+            dead_vid = gen_uuid()
+            await garage.block_ref_table.insert(BlockRef(b"h" * 32, dead_vid))
+
+            async def drain(w):
+                while await w.work() != WorkerState.DONE:
+                    pass
+                return w
+
+            w = await drain(VersionRepairWorker(garage))
+            assert w.fixed >= 1
+            ver = await garage.version_table.get(dangling_vid, b"")
+            assert ver.deleted.get()
+
+            w = await drain(MpuRepairWorker(garage))
+            assert w.fixed >= 1
+            mpu = await garage.mpu_table.get(ghost_mpu.upload_id, b"")
+            assert mpu.deleted.get()
+
+            w = await drain(BlockRefRepairWorker(garage))
+            assert w.fixed >= 1
+            # the intact object survived all three passes
+            assert await client.get_object("rep", "keep")
+
+            # repairs are reachable through the admin rpc too
+            assert "launched" in await rpc(adm, "repair", {"what": "versions"})
+
+            # scrub control
+            garage.spawn_workers() if not hasattr(
+                garage.block_manager, "scrub_worker"
+            ) else None
+            sw = garage.block_manager.scrub_worker
+            out = await rpc(adm, "repair", {"what": "scrub", "cmd": "pause"})
+            assert out["scrub"]["paused"] is True
+            out = await rpc(adm, "repair", {"what": "scrub", "cmd": "resume"})
+            assert out["scrub"]["paused"] is False
+            out = await rpc(
+                adm, "repair",
+                {"what": "scrub", "cmd": "set-tranquility", "value": "9"},
+            )
+            assert sw.state.tranquility == 9
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_admin_api_bucket_key_crud(tmp_path):
+    async def main():
+        import aiohttp
+
+        from garage_tpu.api.admin.api_server import AdminApiServer
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        garage.config.admin.admin_token = "tok"
+        adm = AdminApiServer(garage)
+        await adm.start("127.0.0.1", 0)
+        port = adm.runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        hdr = {"Authorization": "Bearer tok"}
+        try:
+            async with aiohttp.ClientSession(headers=hdr) as sess:
+                # create key, then a bucket wired to it
+                async with sess.post(base + "/v1/key", json={"name": "ops"}) as r:
+                    key = await r.json()
+                    assert key["secretAccessKey"]
+                async with sess.post(
+                    base + "/v1/bucket", json={"globalAlias": "crud-bucket"}
+                ) as r:
+                    b = await r.json()
+                    bid = b["id"]
+                    assert b["globalAliases"] == ["crud-bucket"]
+
+                # UpdateBucket: enable website + quotas
+                async with sess.put(
+                    base + f"/v1/bucket?id={bid}",
+                    json={
+                        "websiteAccess": {
+                            "enabled": True,
+                            "indexDocument": "home.html",
+                        },
+                        "quotas": {"maxSize": 1_000_000, "maxObjects": 5},
+                    },
+                ) as r:
+                    b = await r.json()
+                    assert b["websiteAccess"] is True
+                    assert b["websiteConfig"]["index_document"] == "home.html"
+                    assert b["quotas"]["maxSize"] == 1_000_000
+
+                # permissions show up in bucket info keys
+                async with sess.post(
+                    base + "/v1/bucket/allow",
+                    json={
+                        "bucketId": bid,
+                        "accessKeyId": key["accessKeyId"],
+                        "permissions": {"read": True, "write": True},
+                    },
+                ) as r:
+                    assert r.status == 200
+                async with sess.get(base + f"/v1/bucket?id={bid}") as r:
+                    b = await r.json()
+                    assert b["keys"][0]["permissions"]["write"] is True
+
+                # aliases: global add/remove, local add
+                async with sess.put(
+                    base + f"/v1/bucket/alias/global?id={bid}&alias=second-name"
+                ) as r:
+                    b = await r.json()
+                    assert sorted(b["globalAliases"]) == [
+                        "crud-bucket", "second-name"
+                    ]
+                async with sess.delete(
+                    base + f"/v1/bucket/alias/global?id={bid}&alias=second-name"
+                ) as r:
+                    b = await r.json()
+                    assert b["globalAliases"] == ["crud-bucket"]
+                async with sess.put(
+                    base
+                    + f"/v1/bucket/alias/local?id={bid}"
+                    + f"&accessKeyId={key['accessKeyId']}&alias=mine"
+                ) as r:
+                    b = await r.json()
+                    assert b["keys"][0]["bucketLocalAliases"] == ["mine"]
+
+                # key update + search + import
+                async with sess.post(
+                    base + f"/v1/key?id={key['accessKeyId']}",
+                    json={"name": "renamed", "allow": {"createBucket": True}},
+                ) as r:
+                    k = await r.json()
+                    assert k["name"] == "renamed"
+                    assert k["permissions"]["createBucket"] is True
+                async with sess.get(base + "/v1/key?search=renam") as r:
+                    k = await r.json()
+                    assert k["accessKeyId"] == key["accessKeyId"]
+                async with sess.post(
+                    base + "/v1/key/import",
+                    json={
+                        "accessKeyId": "GK" + "ab" * 12,
+                        "secretAccessKey": "cd" * 32,
+                        "name": "imported",
+                    },
+                ) as r:
+                    k = await r.json()
+                    assert k["accessKeyId"] == "GK" + "ab" * 12
+                # imported key works for real S3 auth
+                from garage_tpu.api.s3.client import S3Client
+
+                c2 = S3Client(endpoint, "GK" + "ab" * 12, "cd" * 32)
+                assert await c2.list_buckets() == []
+                await c2.close()
+        finally:
+            await adm.stop()
+            await teardown(garage, s3)
+
+    run(main())

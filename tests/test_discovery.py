@@ -1,0 +1,200 @@
+"""Consul + Kubernetes peer-discovery publishers against mock REST
+servers (reference src/rpc/consul.rs, kubernetes.rs)."""
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from garage_tpu.rpc.discovery import ConsulDiscovery, KubernetesDiscovery
+from garage_tpu.utils.config import (
+    ConsulDiscoveryConfig,
+    KubernetesDiscoveryConfig,
+    config_from_dict,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _serve(routes):
+    app = web.Application()
+    for method, path, handler in routes:
+        app.router.add_route(method, path, handler)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, runner.addresses[0][1]
+
+
+def test_consul_publish_and_get():
+    registered = {}
+
+    async def register(request):
+        body = await request.json()
+        svc = body["Service"]
+        registered[svc["ID"]] = body
+        return web.json_response(True)
+
+    async def catalog(request):
+        out = []
+        for body in registered.values():
+            svc = body["Service"]
+            out.append(
+                {
+                    "Address": body["Address"],
+                    "ServiceAddress": svc["Address"],
+                    "ServicePort": svc["Port"],
+                    "ServiceMeta": svc["Meta"],
+                }
+            )
+        # plus a malformed entry that must be skipped
+        out.append({"Address": "10.0.0.9", "ServicePort": 1})
+        return web.json_response(out)
+
+    async def main():
+        runner, port = await _serve(
+            [
+                ("PUT", "/v1/catalog/register", register),
+                ("GET", "/v1/catalog/service/garage-tpu", catalog),
+            ]
+        )
+        cfg = ConsulDiscoveryConfig(
+            consul_http_addr=f"http://127.0.0.1:{port}",
+            api="catalog",
+            tags=["extra-tag"],
+        )
+        d = ConsulDiscovery(cfg)
+        try:
+            node_id = b"\xab" * 32
+            await d.publish(node_id, ("10.1.2.3", 3901))
+            ent = registered[f"garage:{node_id.hex()[:16]}"]
+            assert ent["Service"]["Meta"]["garage-tpu-pubkey"] == node_id.hex()
+            assert "extra-tag" in ent["Service"]["Tags"]
+
+            nodes = await d.get_nodes()
+            assert nodes == [(node_id, ("10.1.2.3", 3901))]
+        finally:
+            await d.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_kubernetes_publish_and_get():
+    crs = {}
+
+    async def apply(request):
+        name = request.match_info["name"]
+        crs[name] = json.loads(await request.read())
+        return web.json_response(crs[name])
+
+    async def lst(request):
+        assert "garage.deuxfleurs.fr/service=garage-tpu" in request.query.get(
+            "labelSelector", ""
+        )
+        items = list(crs.values())
+        items.append({"metadata": {"name": "not-hex!"}, "spec": {}})
+        return web.json_response({"items": items})
+
+    async def main():
+        base = "/apis/deuxfleurs.fr/v1/namespaces/ns1/garagenodes"
+        runner, port = await _serve(
+            [("PATCH", base + "/{name}", apply), ("GET", base, lst)]
+        )
+        cfg = KubernetesDiscoveryConfig(
+            namespace="ns1",
+            api_server=f"http://127.0.0.1:{port}",
+            token="test-token",
+        )
+        d = KubernetesDiscovery(cfg)
+        try:
+            node_id = b"\xcd" * 32
+            await d.publish(node_id, ("10.4.5.6", 3901))
+            assert node_id.hex() in crs
+            assert crs[node_id.hex()]["spec"]["port"] == 3901
+
+            nodes = await d.get_nodes()
+            assert nodes == [(node_id, ("10.4.5.6", 3901))]
+        finally:
+            await d.close()
+            await runner.cleanup()
+
+    run(main())
+
+
+def test_discovery_config_parsing():
+    cfg = config_from_dict(
+        {
+            "metadata_dir": "/tmp/x",
+            "rpc_secret": "aa" * 32,
+            "consul_discovery": {
+                "consul_http_addr": "http://consul:8500",
+                "api": "agent",
+                "token": "t0k",
+            },
+            "kubernetes_discovery": {"namespace": "prod", "skip_crd": True},
+        }
+    )
+    assert cfg.consul_discovery.api == "agent"
+    assert cfg.consul_discovery.token == "t0k"
+    assert cfg.kubernetes_discovery.namespace == "prod"
+    assert cfg.kubernetes_discovery.skip_crd is True
+
+    from garage_tpu.rpc.discovery import discovery_from_config
+
+    ds = discovery_from_config(cfg)
+    assert len(ds) == 2
+
+
+def test_system_discovery_loop_connects_peers(tmp_path):
+    """A node published only in Consul gets dialed by the discovery loop."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_s3_api import make_daemon, teardown
+
+    async def main():
+        # daemon B is the "remote" node that A discovers via consul
+        garage_b, s3_b, _ep_b = await make_daemon(tmp_path, name="nodeB")
+
+        async def catalog(request):
+            return web.json_response(
+                [
+                    {
+                        "Address": "127.0.0.1",
+                        "ServiceAddress": "127.0.0.1",
+                        "ServicePort": garage_b.netapp.bind_addr[1],
+                        "ServiceMeta": {
+                            "garage-tpu-pubkey": garage_b.node_id.hex()
+                        },
+                    }
+                ]
+            )
+
+        async def register(request):
+            return web.json_response(True)
+
+        runner, port = await _serve(
+            [
+                ("GET", "/v1/catalog/service/garage-tpu", catalog),
+                ("PUT", "/v1/catalog/register", register),
+            ]
+        )
+        garage_a, s3_a, _ep_a = await make_daemon(tmp_path, name="nodeA")
+        d = ConsulDiscovery(
+            ConsulDiscoveryConfig(consul_http_addr=f"http://127.0.0.1:{port}")
+        )
+        garage_a.system.discovery.append(d)
+        try:
+            await garage_a.system._external_discovery()
+            assert garage_a.netapp.is_connected(garage_b.node_id)
+        finally:
+            await runner.cleanup()
+            await teardown(garage_a, s3_a)
+            await teardown(garage_b, s3_b)
+
+    run(main())

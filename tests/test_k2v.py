@@ -182,6 +182,173 @@ def test_k2v_poll(tmp_path):
     run(main())
 
 
+def test_k2v_poll_range(tmp_path):
+    async def main():
+        garage, s3, k2v, client = await k2v_daemon(tmp_path)
+        try:
+            await client.insert_item("room", "msg1", b"first")
+            await client.insert_item("room", "msg2", b"second")
+
+            # no marker: immediate snapshot + initial marker
+            items, marker = await client.poll_range("room")
+            assert sorted(items) == ["msg1", "msg2"]
+
+            # nothing new: times out with 304
+            res = await client.poll_range("room", seen_marker=marker, timeout=1)
+            assert res is None
+
+            # a write wakes the poll and only the new item is returned
+            async def updater():
+                await asyncio.sleep(0.3)
+                await client.insert_item("room", "msg3", b"third")
+
+            up = asyncio.create_task(updater())
+            items2, marker2 = await client.poll_range(
+                "room", seen_marker=marker, timeout=10
+            )
+            await up
+            assert list(items2) == ["msg3"]
+            assert items2["msg3"]["v"] == [b"third"]
+
+            # deletions are events too: the tombstone arrives as null
+            _vals, tok = await client.read_item("room", "msg1")
+
+            async def deleter():
+                await asyncio.sleep(0.3)
+                await client.delete_item("room", "msg1", tok)
+
+            dl = asyncio.create_task(deleter())
+            items3, marker3 = await client.poll_range(
+                "room", seen_marker=marker2, timeout=10
+            )
+            await dl
+            assert list(items3) == ["msg1"]
+            assert items3["msg1"]["v"] == [None]
+
+            # prefix/range restriction filters events
+            async def noise():
+                await asyncio.sleep(0.3)
+                await client.insert_item("room", "other", b"x")
+                await client.insert_item("room", "msg4", b"in range")
+
+            nz = asyncio.create_task(noise())
+            items4, _m4 = await client.poll_range(
+                "room", seen_marker=marker3, prefix="msg", timeout=10
+            )
+            await nz
+            assert list(items4) == ["msg4"]
+        finally:
+            await client.close()
+            await k2v.stop()
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_k2v_poll_fans_out_to_replicas(tmp_path):
+    """A poll served by node 0 must observe a write that exists only on
+    OTHER replicas (reference rpc.rs:206- distributed poll) — the exact
+    scenario a local-only poll misses."""
+    from test_ec_cluster import make_ec_cluster, stop_cluster
+
+    from garage_tpu.model.k2v.item_table import K2VItem
+    from garage_tpu.utils.serde import pack
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="3")
+        try:
+            bucket_id = b"k" * 32
+
+            def plant(sk: str, value: bytes, nodes):
+                """Write an item into specific replicas' LOCAL stores only
+                (simulating a write the polling node hasn't received)."""
+                from garage_tpu.utils.time_util import now_msec
+
+                # ONE write allocated on the first node, replicated to the
+                # given stores only (the polling node is left stale)
+                item = K2VItem(bucket_id, "pk", sk)
+                item.update(nodes[0].node_id, None, value, now_msec())
+                packed = pack(nodes[0].k2v_item_table.schema.encode_entry(item))
+                for g in nodes:
+                    g.k2v_item_table.data.update_entry(packed)
+
+            # poll_item from node 0 while the item lives only on nodes 1+2
+            async def plant_later():
+                await asyncio.sleep(0.3)
+                plant("ev", b"remote-write", [garages[1], garages[2]])
+
+            pl = asyncio.create_task(plant_later())
+            item = await garages[0].k2v_rpc.poll_item(
+                bucket_id, "pk", "ev", CausalContext(), timeout=10
+            )
+            await pl
+            assert item is not None, "fan-out poll missed a remote-only write"
+            assert item.live_values() == [b"remote-write"]
+
+            # poll_range from node 0: snapshot, then a remote-only write
+            snap = await garages[0].k2v_rpc.poll_range(
+                bucket_id, "pk", None, None, None, None, timeout=5
+            )
+            assert snap is not None
+            _items, marker = snap
+
+            async def plant_more():
+                await asyncio.sleep(0.3)
+                plant("ev2", b"second-remote", [garages[1], garages[2]])
+
+            pm = asyncio.create_task(plant_more())
+            res = await garages[0].k2v_rpc.poll_range(
+                bucket_id, "pk", None, None, None, marker, timeout=10
+            )
+            await pm
+            assert res is not None, "range poll missed a remote-only write"
+            new_items, _marker2 = res
+            assert "ev2" in new_items
+            assert new_items["ev2"].live_values() == [b"second-remote"]
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
+
+
+def test_range_seen_marker():
+    """RangeSeenMarker unit laws: clock coverage, per-item pinning,
+    canonicalization, restrict, encode/decode roundtrip."""
+    from garage_tpu.model.k2v.seen import RangeSeenMarker
+
+    def item(sk: str, writes: dict[bytes, int]) -> K2VItem:
+        it = K2VItem(b"b" * 32, "pk", sk)
+        it.items = {n: {"t": 0, "v": [[t, b"x"]]} for n, t in writes.items()}
+        return it
+
+    n1, n2 = nid(1), nid(2)
+    m = RangeSeenMarker()
+    assert m.is_new_item(item("a", {n1: 1}))
+
+    m.mark_seen_node_items(n1, [item("a", {n1: 3})])
+    assert not m.is_new_item(item("a", {n1: 3}))
+    assert not m.is_new_item(item("b", {n1: 2}))  # clock covers all of n1<=3
+    assert m.is_new_item(item("b", {n1: 4}))
+
+    # an item carrying entries from another node gets pinned individually
+    m.mark_seen_node_items(n1, [item("c", {n1: 5, n2: 7})])
+    assert not m.is_new_item(item("c", {n1: 5, n2: 7}))
+    assert m.is_new_item(item("c", {n1: 5, n2: 8}))
+    # ...but other items with unseen n2 progress are still new
+    assert m.is_new_item(item("d", {n2: 1}))
+
+    # roundtrip
+    m2 = RangeSeenMarker.decode(m.encode())
+    assert m2 is not None
+    assert m2.vector_clock == m.vector_clock
+    assert not m2.is_new_item(item("c", {n1: 5, n2: 7}))
+    assert RangeSeenMarker.decode("garbage!!") is None
+
+    # restrict drops out-of-range pins
+    m.restrict(None, None, "zzz")
+    assert m.items == {}
+
+
 def test_dvvs_delete_sticks_on_stale_replica():
     """A causal delete routed to a replica that hasn't seen the deleted
     value must still discard it after anti-entropy (regression for the

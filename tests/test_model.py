@@ -1,0 +1,81 @@
+"""Model-layer CRDT unit tests: Object version-list merge semantics
+(reference src/model/s3/object_table.rs:413-527)."""
+
+from garage_tpu.model.s3.object_table import Object, ObjectVersion
+
+
+def _v(uuid: bytes, ts: int, state: str, t: str = "first_block") -> ObjectVersion:
+    data = {"t": t}
+    if t == "first_block":
+        data["vid"] = uuid
+    return ObjectVersion(uuid, ts, state, data)
+
+
+def _ids(o: Object) -> list[tuple[bytes, str]]:
+    return [(v.uuid, v.state) for v in o.versions]
+
+
+def test_aborted_version_is_persistent_tombstone():
+    """An aborted version must survive the merge so a replica that missed
+    the abort converges to aborted instead of resurrecting the upload
+    (reference keeps Aborted as a terminal CRDT state)."""
+    bkt, key = b"B" * 32, "k"
+    up = Object(bkt, key, [_v(b"u" * 32, 10, "uploading")])
+    ab = Object(bkt, key, [_v(b"u" * 32, 10, "aborted")])
+
+    # replica that has the abort merges the stale uploading state: stays aborted
+    ab_m = Object(bkt, key, list(ab.versions))
+    ab_m.merge(up)
+    assert _ids(ab_m) == [(b"u" * 32, "aborted")]
+
+    # stale replica receives the abort: converges to aborted, and the
+    # aborted marker REMAINS (it is not dropped from the version list)
+    up_m = Object(bkt, key, list(up.versions))
+    up_m.merge(ab)
+    assert _ids(up_m) == [(b"u" * 32, "aborted")]
+
+    # convergence: merging the stale state again changes nothing
+    up_m.merge(Object(bkt, key, [_v(b"u" * 32, 10, "uploading")]))
+    assert _ids(up_m) == [(b"u" * 32, "aborted")]
+
+
+def test_newer_complete_prunes_older_versions_including_aborted():
+    bkt, key = b"B" * 32, "k"
+    o = Object(
+        bkt,
+        key,
+        [
+            _v(b"a" * 32, 5, "aborted"),
+            _v(b"u" * 32, 7, "uploading"),
+            _v(b"c" * 32, 10, "complete"),
+        ],
+    )
+    o.merge(Object(bkt, key, []))
+    # everything strictly older than the newest complete version is pruned
+    assert _ids(o) == [(b"c" * 32, "complete")]
+
+    # but aborted/uploading versions NEWER than the complete one are kept
+    o2 = Object(
+        bkt,
+        key,
+        [
+            _v(b"c" * 32, 10, "complete"),
+            _v(b"n" * 32, 12, "aborted"),
+            _v(b"w" * 32, 13, "uploading"),
+        ],
+    )
+    o2.merge(Object(bkt, key, []))
+    assert _ids(o2) == [
+        (b"c" * 32, "complete"),
+        (b"n" * 32, "aborted"),
+        (b"w" * 32, "uploading"),
+    ]
+
+
+def test_complete_beats_uploading_but_not_aborted():
+    bkt, key = b"B" * 32, "k"
+    a = Object(bkt, key, [_v(b"u" * 32, 10, "uploading")])
+    a.merge(Object(bkt, key, [_v(b"u" * 32, 10, "complete")]))
+    assert _ids(a) == [(b"u" * 32, "complete")]
+    a.merge(Object(bkt, key, [_v(b"u" * 32, 10, "aborted")]))
+    assert _ids(a) == [(b"u" * 32, "aborted")]

@@ -123,6 +123,79 @@ def test_peer_id_pinning():
     run(main())
 
 
+def test_reflection_attack_rejected():
+    """A peer that knows only the network key and echoes our own auth
+    frame back must NOT authenticate (signatures are role+identity-bound;
+    an identical frame is rejected outright)."""
+
+    async def main():
+        import hashlib
+        import hmac as hmac_mod
+        import struct
+
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+            X25519PublicKey,
+        )
+        from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+        from garage_tpu.net import handshake as hs
+
+        netkey = NETKEY
+
+        async def evil_server(reader, writer):
+            # steps 1-2 performed honestly (attacker knows the network key)
+            my_nonce = b"\x01" * 32
+            eph = X25519PrivateKey.generate()
+            eph_pub = eph.public_key().public_bytes_raw()
+            body = hs.VERSION_TAG + my_nonce + eph_pub
+            mac = hmac_mod.new(netkey, body, hashlib.sha256).digest()
+            writer.write(body + mac)
+            await writer.drain()
+            peer_hello = await reader.readexactly(len(body) + 32)
+            peer_body = peer_hello[:-32]
+            peer_nonce = peer_body[len(hs.VERSION_TAG) : len(hs.VERSION_TAG) + 32]
+            peer_eph = peer_body[len(hs.VERSION_TAG) + 32 :]
+            shared = eph.exchange(X25519PublicKey.from_public_bytes(peer_eph))
+            info = my_nonce + peer_nonce
+            k_s2c = hs._hkdf(shared, netkey, info + b"s2c", 32)
+            k_c2s = hs._hkdf(shared, netkey, info + b"c2s", 32)
+            # step 3: receive the client's auth frame and echo it back
+            hdr = await reader.readexactly(4)
+            (n,) = struct.unpack("<I", hdr)
+            ct = await reader.readexactly(n)
+            client_auth = ChaCha20Poly1305(k_c2s).decrypt(
+                b"send" + struct.pack("<Q", 0), ct, None
+            )
+            echo = ChaCha20Poly1305(k_s2c).encrypt(
+                b"send" + struct.pack("<Q", 0), client_auth, None
+            )
+            writer.write(struct.pack("<I", len(echo)) + echo)
+            await writer.drain()
+            # let the client read the echo, then close our transport
+            # (3.12's Server.wait_closed blocks on open connections)
+            try:
+                await asyncio.wait_for(reader.read(1), 5)
+            except asyncio.TimeoutError:
+                pass
+            writer.close()
+
+        server = await asyncio.start_server(evil_server, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        with pytest.raises(HandshakeError, match="reflection|signature invalid"):
+            await asyncio.wait_for(
+                hs.handshake(
+                    reader, writer, netkey, gen_node_key(), is_server=False
+                ),
+                timeout=15,
+            )
+        writer.close()
+        server.close()
+
+    run(main())
+
+
 def test_three_node_mesh_converges():
     """a knows b, b knows c: peer-list exchange must close the mesh so a
     discovers and connects to c (reference net/test.rs:15-44)."""

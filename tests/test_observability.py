@@ -1,0 +1,134 @@
+"""Observability: latency histograms, scrape-time gauges, span tracing
+with OTLP export (reference: OTel meters + tracing_setup.rs)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from garage_tpu.utils.metrics import BUCKETS, Metrics
+from garage_tpu.utils.tracing import Tracer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_histogram_buckets_and_quantiles():
+    m = Metrics()
+    for ms in [1, 1, 2, 4, 100]:
+        m.observe("op_duration", (), ms / 1000.0)
+    lines = m.render()
+    # cumulative bucket counts, +Inf == count
+    assert any("op_duration_bucket" in ln and 'le="+Inf"' in ln and ln.endswith(" 5") for ln in lines)
+    assert "op_duration_count 5" in lines
+    # p50 should be around 1-2 ms, p99 near the 100 ms outlier
+    assert m.quantile("op_duration", (), 0.5) <= 0.004
+    assert m.quantile("op_duration", (), 0.99) >= 0.1
+    assert m.quantile("op_duration", (), 0.99) <= 0.3
+    assert m.quantile("missing", (), 0.5) is None
+
+
+def test_gauges_render_and_failures_dropped():
+    m = Metrics()
+    m.set_gauge("queue_depth", (), 7)
+    m.register_gauge("live_value", (("t", "x"),), lambda: 42)
+    m.register_gauge("dead_value", (), lambda: 1 / 0)
+    lines = m.render()
+    assert "queue_depth 7" in lines
+    assert 'live_value{t="x"} 42' in lines
+    assert not any("dead_value" in ln for ln in lines)
+    m.unregister_gauge("live_value", (("t", "x"),))
+    assert not any("live_value" in ln for ln in m.render())
+
+
+def test_daemon_metrics_endpoint_has_gauges_and_histograms(tmp_path):
+    from test_s3_api import make_client, make_daemon, teardown
+
+    from garage_tpu.api.admin.api_server import AdminApiServer
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        admin = AdminApiServer(garage)
+        await admin.start("127.0.0.1", 0)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("obs")
+            await client.put_object("obs", "k", b"x" * 10_000)
+            await client.get_object("obs", "k")
+
+            import aiohttp
+
+            port = admin.runner.addresses[0][1]
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{port}/metrics") as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+            assert "block_resync_queue_length" in text
+            assert "table_merkle_updater_todo_queue_length" in text
+            assert 'api_s3_request_duration_bucket' in text
+            assert 'le="+Inf"' in text
+            assert "cluster_connected_nodes 0" in text
+        finally:
+            await admin.stop()
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_tracer_spans_nest_and_export():
+    """Spans nest via contextvars and export OTLP/HTTP JSON to the sink."""
+    from aiohttp import web
+
+    received = []
+
+    async def collector(request):
+        received.append(await request.json())
+        return web.Response(status=200)
+
+    async def main():
+        app = web.Application()
+        app.router.add_post("/v1/traces", collector)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+
+        t = Tracer()
+        t.configure(f"http://127.0.0.1:{port}")
+        with t.span("outer", kind="test"):
+            outer = t.current()
+            with t.span("inner"):
+                inner = t.current()
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+            # sibling after inner closed: parent restored
+            assert t.current() is outer
+        assert t.current() is None
+        await t._flush()
+        await t.stop()
+        await runner.cleanup()
+
+        assert received, "collector got no spans"
+        spans = received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) == {"outer", "inner"}
+        assert by_name["inner"]["parentSpanId"] == by_name["outer"]["spanId"]
+        assert by_name["inner"]["traceId"] == by_name["outer"]["traceId"]
+        assert "parentSpanId" not in by_name["outer"]
+        assert int(by_name["outer"]["endTimeUnixNano"]) >= int(
+            by_name["outer"]["startTimeUnixNano"]
+        )
+        attrs = {a["key"]: a["value"] for a in by_name["outer"]["attributes"]}
+        assert attrs["kind"] == {"stringValue": "test"}
+
+    run(main())
+
+
+def test_tracer_disabled_is_noop():
+    t = Tracer()
+    with t.span("x") as s:
+        assert s is None
+    assert t._buf == []

@@ -178,6 +178,13 @@ def test_try_write_many_sets():
                 await helper.try_write_many_sets(
                     ep, [[ids[0], ids[1]], [ids[1], ids[2]]], "x", quorum=2
                 )
+
+            # a write set smaller than the quorum must fail loudly up
+            # front, not silently lower the durability bar
+            with pytest.raises(Quorum, match="< quorum"):
+                await helper.try_write_many_sets(
+                    ep, [[ids[0], ids[1]], [ids[2]]], "x", quorum=2
+                )
         finally:
             await stop_cluster(apps, systems)
 

@@ -1,0 +1,116 @@
+"""Version-portable store exerciser for the upgrade test (reference
+script/test-upgrade.sh:14-25).
+
+Runs under BOTH the old (round-1) and current checkouts — it only touches
+APIs that existed in round 1: config_from_dict, Garage, S3ApiServer,
+S3Client.  Invoked as a subprocess with PYTHONPATH pointing at the
+checkout under test.
+
+    python upgrade_script.py write <store_dir>   # create bucket + objects
+    python upgrade_script.py read  <store_dir>   # verify them all
+"""
+
+import asyncio
+import hashlib
+import json
+import os
+import sys
+
+
+def deterministic_bytes(n: int, seed: int) -> bytes:
+    out = bytearray()
+    h = hashlib.sha256(str(seed).encode()).digest()
+    while len(out) < n:
+        out.extend(h)
+        h = hashlib.sha256(h).digest()
+    return bytes(out[:n])
+
+
+OBJECTS = [
+    ("inline.txt", 100),       # inline (< threshold)
+    ("one-block.bin", 3500),   # single block
+    ("multi-block.bin", 40_000),  # many 4096-byte blocks
+]
+
+
+async def boot(store_dir):
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.model.garage import Garage
+    from garage_tpu.rpc.layout.types import NodeRole
+    from garage_tpu.utils.config import config_from_dict
+
+    cfg = config_from_dict(
+        {
+            "metadata_dir": os.path.join(store_dir, "meta"),
+            "data_dir": os.path.join(store_dir, "data"),
+            "db_engine": "sqlite",
+            "replication_factor": 1,
+            "rpc_bind_addr": "127.0.0.1:0",
+            "rpc_secret": "ab" * 32,
+            "block_size": 4096,
+            "s3_api": {"api_bind_addr": "127.0.0.1:0", "s3_region": "garage"},
+        }
+    )
+    garage = Garage(cfg)
+    await garage.start()
+    if not garage.layout_manager.history.current().ring_assignment:
+        garage.layout_manager.stage_role(
+            garage.node_id, NodeRole(zone="dc1", capacity=10**12)
+        )
+        garage.layout_manager.apply_staged()
+    garage.spawn_workers()
+    s3 = S3ApiServer(garage)
+    await s3.start("127.0.0.1", 0)
+    port = s3.runner.addresses[0][1]
+    return garage, s3, f"http://127.0.0.1:{port}"
+
+
+async def write(store_dir):
+    from garage_tpu.api.s3.client import S3Client
+
+    garage, s3, endpoint = await boot(store_dir)
+    try:
+        key = await garage.helper.create_key("upgrade-key")
+        key.params().allow_create_bucket.update(True)
+        await garage.key_table.insert(key)
+        client = S3Client(endpoint, key.key_id, key.secret())
+        await client.create_bucket("upgrade-bucket")
+        for name, size in OBJECTS:
+            await client.put_object(
+                "upgrade-bucket", name, deterministic_bytes(size, len(name))
+            )
+        await client.close()
+        with open(os.path.join(store_dir, "creds.json"), "w") as f:
+            json.dump({"key_id": key.key_id, "secret": key.secret()}, f)
+        print("WRITE-OK")
+    finally:
+        await s3.stop()
+        await garage.stop()
+
+
+async def read(store_dir):
+    from garage_tpu.api.s3.client import S3Client
+
+    garage, s3, endpoint = await boot(store_dir)
+    try:
+        with open(os.path.join(store_dir, "creds.json")) as f:
+            creds = json.load(f)
+        client = S3Client(endpoint, creds["key_id"], creds["secret"])
+        assert await client.list_buckets() == ["upgrade-bucket"]
+        for name, size in OBJECTS:
+            got = await client.get_object("upgrade-bucket", name)
+            want = deterministic_bytes(size, len(name))
+            assert got == want, f"{name}: data mismatch after upgrade"
+        # the store is also writable with the new version
+        await client.put_object("upgrade-bucket", "post-upgrade.bin", b"new!")
+        assert await client.get_object("upgrade-bucket", "post-upgrade.bin") == b"new!"
+        await client.close()
+        print("READ-OK")
+    finally:
+        await s3.stop()
+        await garage.stop()
+
+
+if __name__ == "__main__":
+    mode, store_dir = sys.argv[1], sys.argv[2]
+    asyncio.run(write(store_dir) if mode == "write" else read(store_dir))
