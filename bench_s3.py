@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""S3 PUT/GET latency benchmark: erasure-coded vs replicated block store.
+
+BASELINE.md north star: "S3 PUT p99 <= 1.2x of 3-replica mode".  Boots two
+in-process 3-node clusters (replication "3" and EC(2,1)), drives identical
+PUT+GET workloads through the real S3 HTTP API, and reports p50/p99 from
+the api_s3_request_duration latency histograms (utils/metrics.py).
+
+    python bench_s3.py [--objects 200] [--size 65536]
+
+Prints ONE JSON line: {"metric": "s3_put_p99_ec_over_replica", ...}.
+Runs on CPU (numpy codec) — the ratio isolates protocol overhead, which is
+what the target bounds; absolute GB/s lives in bench.py.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+
+# never dial the TPU tunnel from a latency benchmark
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+
+
+async def run_cluster(tmp_path, mode: str, n_objects: int, size: int) -> dict:
+    from test_ec_cluster import make_ec_cluster, stop_cluster
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.s3.client import S3Client
+    from garage_tpu.utils import metrics as metrics_mod
+
+    # fresh registry per cluster so histograms don't mix
+    registry = metrics_mod.Metrics()
+    metrics_mod.registry = registry
+
+    garages = await make_ec_cluster(tmp_path, n=3, mode=mode, block_size=65536)
+    s3 = S3ApiServer(garages[0])
+    await s3.start("127.0.0.1", 0)
+    ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
+    key = await garages[0].helper.create_key("bench")
+    key.params().allow_create_bucket.update(True)
+    await garages[0].key_table.insert(key)
+    client = S3Client(ep, key.key_id, key.secret())
+    try:
+        await client.create_bucket("bench")
+        body = os.urandom(size)
+        for i in range(n_objects):
+            await client.put_object("bench", f"o{i:05d}", body)
+        for i in range(0, n_objects, 4):
+            await client.get_object("bench", f"o{i:05d}")
+        put_lbl = (("method", "PUT"),)
+        get_lbl = (("method", "GET"),)
+        return {
+            "put_p50": registry.quantile("api_s3_request_duration", put_lbl, 0.5),
+            "put_p99": registry.quantile("api_s3_request_duration", put_lbl, 0.99),
+            "get_p99": registry.quantile("api_s3_request_duration", get_lbl, 0.99),
+        }
+    finally:
+        await stop_cluster(garages, [s3], [client])
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--objects", type=int, default=200)
+    ap.add_argument("--size", type=int, default=64 * 1024)
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as d1:
+        import pathlib
+
+        rep = await run_cluster(
+            pathlib.Path(d1), "3", args.objects, args.size
+        )
+    with tempfile.TemporaryDirectory() as d2:
+        import pathlib
+
+        ec = await run_cluster(
+            pathlib.Path(d2), "ec:2:1", args.objects, args.size
+        )
+
+    ratio = (
+        ec["put_p99"] / rep["put_p99"]
+        if rep["put_p99"] and ec["put_p99"]
+        else None
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "s3_put_p99_ec_over_replica",
+                "value": round(ratio, 3) if ratio else None,
+                "unit": "ratio",
+                "vs_baseline": round(1.2 / ratio, 3) if ratio else None,
+                "detail": {
+                    "replica_ms": {
+                        k: round(v * 1000, 2) if v else None
+                        for k, v in rep.items()
+                    },
+                    "ec21_ms": {
+                        k: round(v * 1000, 2) if v else None
+                        for k, v in ec.items()
+                    },
+                    "objects": args.objects,
+                    "size": args.size,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -24,7 +24,10 @@ from ..common.error import ApiError, BadRequest
 ALG_HEADER = "x-amz-server-side-encryption-customer-algorithm"
 KEY_HEADER = "x-amz-server-side-encryption-customer-key"
 MD5_HEADER = "x-amz-server-side-encryption-customer-key-md5"
-COPY_PREFIX = "x-amz-copy-source-"  # UploadPartCopy names the source key with these
+# UploadPartCopy names the SOURCE key with these (AWS spec: the
+# "x-amz-copy-source-" prefix replaces the leading "x-amz-", it does not
+# stack on top of it)
+COPY_ALG_HEADER = "x-amz-copy-source-server-side-encryption-customer-algorithm"
 
 NONCE_LEN = 12
 TAG_LEN = 16
@@ -40,31 +43,37 @@ class EncryptionParams:
         self._aead = AESGCM(key)
 
     @classmethod
-    def from_headers(cls, headers, prefix: str = "") -> "EncryptionParams | None":
+    def from_headers(cls, headers, copy_source: bool = False) -> "EncryptionParams | None":
+        def hname(base: str) -> str:
+            if copy_source:
+                return "x-amz-copy-source-" + base[len("x-amz-"):]
+            return base
+
         h = {k.lower(): v for k, v in headers.items()}
-        alg = h.get(prefix + ALG_HEADER)
+        alg = h.get(hname(ALG_HEADER))
         if alg is None:
-            if prefix + KEY_HEADER in h or prefix + MD5_HEADER in h:
+            if hname(KEY_HEADER) in h or hname(MD5_HEADER) in h:
                 raise BadRequest("SSE-C key supplied without algorithm header")
             return None
         if alg != "AES256":
             raise BadRequest(f"unsupported SSE-C algorithm {alg!r}")
         try:
-            key = base64.b64decode(h.get(prefix + KEY_HEADER, ""))
+            key = base64.b64decode(h.get(hname(KEY_HEADER), ""))
         except Exception as e:
             raise BadRequest(f"bad SSE-C key encoding: {e}") from e
         if len(key) != 32:
             raise BadRequest("SSE-C key must be 256 bits")
-        md5_b64 = h.get(prefix + MD5_HEADER, "")
+        md5_b64 = h.get(hname(MD5_HEADER), "")
         if base64.b64encode(hashlib.md5(key).digest()).decode() != md5_b64:
             raise BadRequest("SSE-C key MD5 mismatch")
         return cls(key, md5_b64)
 
     @classmethod
     def from_copy_source_headers(cls, headers) -> "EncryptionParams | None":
-        """The x-amz-copy-source-…-customer-* key naming the SOURCE object
-        of an UploadPartCopy (reference encryption.rs)."""
-        return cls.from_headers(headers, prefix=COPY_PREFIX)
+        """The x-amz-copy-source-server-side-encryption-customer-* key
+        naming the SOURCE object of an UploadPartCopy (reference
+        encryption.rs)."""
+        return cls.from_headers(headers, copy_source=True)
 
     # --- block sealing --------------------------------------------------------
 

@@ -10,9 +10,13 @@ returning.  Remote ops on endpoint `block/data`:
   ["Get", hash]                     -> {"c":..}, data   read stored form
   ["Need", hash]                    -> bool   does this node still need it?
 
-Block payloads ride the message body (the frame scheduler chunks them at
-16 KiB with priority QoS); dedicated zero-copy streams are a later
-optimization.
+Block payloads ride ATTACHED BYTE STREAMS (reference src/net/stream.rs +
+manager.rs:366 rpc_put_block streaming): the body carries only the small
+msgpack header, the payload flows as stream chunks through the frame
+scheduler's priority QoS, and the serving side reads files in chunks
+instead of one big buffer.  Aggregate payload RAM is bounded by a
+`block_ram_buffer_max` byte-budget semaphore (reference manager.rs:96) —
+a resync burst queues behind the budget instead of ballooning RSS.
 
 With an erasure codec (`replication_mode = ec:k:m`), each node in the
 block's assignment stores the piece whose index equals the node's rank in
@@ -53,6 +57,36 @@ INLINE_THRESHOLD = 3072  # smaller objects inline in the object table
 # (v1 "GTP1" files without the hash are still readable.)
 PIECE_MAGIC_V1 = b"GTP1"
 PIECE_MAGIC = b"GTP2"
+
+
+def _file_stream(path: str, chunk: int = 256 * 1024):
+    """Async generator reading a block file in chunks (serving side of
+    streamed Get: no whole-file buffer)."""
+
+    async def gen():
+        with open(path, "rb") as f:
+            while True:
+                b = f.read(chunk)
+                if not b:
+                    return
+                yield b
+
+    return gen()
+
+
+async def _resp_payload(resp, budget=None) -> tuple[dict, bytes]:
+    """(meta, stored_bytes) from a Get response — streamed or legacy
+    inline.  With `budget`, RAM is reserved (from the declared size)
+    BEFORE the stream is buffered."""
+    body = resp.body
+    if len(body) > 2 and body[2] is not None:
+        return body[1], bytes(body[2])
+    from ..net.stream import read_stream_to_end
+
+    if budget is not None:
+        async with budget.reserve(int(body[1].get("s", 4 * 1024 * 1024))):
+            return body[1], await read_stream_to_end(resp.stream)
+    return body[1], await read_stream_to_end(resp.stream)
 
 
 def piece_hash(piece: bytes) -> bytes:
@@ -96,6 +130,55 @@ def stored_piece_parts(stored: bytes) -> tuple[int, bytes, bytes] | None:
     )
 
 
+import contextvars
+
+# re-entrancy marker: a task that already holds a ByteBudget reservation
+# must not block on a nested one — the local-shortcut RPC path dispatches
+# the Put handler IN the caller's task, and caller + handler reserving
+# from the same budget would deadlock once the budget is contended
+_budget_held: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "block_budget_held", default=False
+)
+
+
+class ByteBudget:
+    """Async RAM budget: holders of block payload buffers `reserve(n)`
+    bytes; when the budget is exhausted new work waits instead of
+    allocating (reference manager.rs block_ram_buffer_max semaphore).
+    Re-entrant per task: a nested reserve inside a held one is free."""
+
+    def __init__(self, limit: int):
+        self.limit = max(1, limit)
+        self.used = 0
+        self._cond = asyncio.Condition()
+
+    def reserve(self, n: int):
+        from contextlib import asynccontextmanager
+
+        # one oversized item may exceed the budget alone (never deadlock)
+        n = min(n, self.limit)
+
+        @asynccontextmanager
+        async def ctx():
+            if _budget_held.get():
+                yield  # caller's reservation already covers this task
+                return
+            async with self._cond:
+                while self.used + n > self.limit:
+                    await self._cond.wait()
+                self.used += n
+            token = _budget_held.set(True)
+            try:
+                yield
+            finally:
+                _budget_held.reset(token)
+                async with self._cond:
+                    self.used -= n
+                    self._cond.notify_all()
+
+        return ctx()
+
+
 class BlockManager:
     def __init__(
         self,
@@ -107,6 +190,7 @@ class BlockManager:
         compression_level: int | None = 1,
         codec: BlockCodec | None = None,
         data_fsync: bool = False,
+        ram_buffer_max: int = 256 * 1024 * 1024,
     ):
         self.system = system
         self.helper = helper
@@ -115,6 +199,7 @@ class BlockManager:
         self.codec = codec or ReplicaCodec()
         self.compression_level = compression_level
         self.data_fsync = data_fsync
+        self.buffers = ByteBudget(ram_buffer_max)
         self.rc = BlockRc(db)
 
         self._layout_persister: Persister[DataLayout] = Persister(
@@ -277,17 +362,26 @@ class BlockManager:
     async def _handle(self, from_id: bytes, req: Req) -> Resp:
         op = req.body
         if op[0] == "Put":
-            hash32, meta, payload = bytes(op[1]), op[2], bytes(op[3])
-            piece = int(meta.get("p", 0))
-            if self.codec.n_pieces == 1 and not bool(meta.get("c")):
-                # replica mode stores the block itself: verify before storing
-                if blake2sum(payload) != hash32:
-                    raise Error("put payload does not match block hash")
-            if "l" in meta:  # fresh EC piece: wrap with its block length
-                payload = wrap_piece(int(meta["l"]), payload)
-            await self.write_block_local(
-                hash32, payload, bool(meta.get("c")), piece=piece
-            )
+            hash32, meta = bytes(op[1]), op[2]
+            # reserve BEFORE buffering the payload (the sender declares the
+            # size in meta["s"]) — this is what actually bounds receiver RSS
+            async with self.buffers.reserve(int(meta.get("s", 4 * 1024 * 1024))):
+                if len(op) > 3 and op[3] is not None:
+                    payload = bytes(op[3])  # legacy inline-body form
+                else:
+                    from ..net.stream import read_stream_to_end
+
+                    payload = await read_stream_to_end(req.stream)
+                piece = int(meta.get("p", 0))
+                if self.codec.n_pieces == 1 and not bool(meta.get("c")):
+                    # replica mode stores the block itself: verify first
+                    if blake2sum(payload) != hash32:
+                        raise Error("put payload does not match block hash")
+                if "l" in meta:  # fresh EC piece: wrap with its block length
+                    payload = wrap_piece(int(meta["l"]), payload)
+                await self.write_block_local(
+                    hash32, payload, bool(meta.get("c")), piece=piece
+                )
             return Resp(None)
         if op[0] == "Get":
             hash32 = bytes(op[1])
@@ -296,9 +390,13 @@ class BlockManager:
             if found is None:
                 raise Error(f"block {hash32.hex()[:16]} piece {piece} not found")
             path, compressed = found
-            with open(path, "rb") as f:
-                stored = f.read()
-            return Resp(["ok", {"c": compressed}, stored])
+            # stream the file in chunks: the whole block never sits in one
+            # send buffer, and the QoS scheduler interleaves other traffic;
+            # "s" lets the receiver reserve RAM before buffering
+            size = os.path.getsize(path)
+            return Resp(
+                ["ok", {"c": compressed, "s": size}], stream=_file_stream(path)
+            )
         if op[0] == "Need":
             hash32 = bytes(op[1])
             return Resp(self.rc.is_needed(hash32) and not self.has_block(hash32))
@@ -311,19 +409,24 @@ class BlockManager:
 
     async def rpc_put_block(self, hash32: bytes, data: bytes) -> None:
         """Store a block on its replica set (quorum in every active layout
-        version).  With an EC codec, each node receives only its piece."""
+        version).  With an EC codec, each node receives only its piece.
+        Payloads ride attached streams; aggregate buffer RAM is budgeted."""
+        from ..net.stream import bytes_stream
+
         layout = self.system.layout_manager.history
         write_sets = layout.write_sets_of(hash32)
         quorum = self.system.replication_mode.write_quorum()
         if self.codec.n_pieces == 1:
             stored, compressed = self._maybe_compress(data)
-            await self.helper.try_write_many_sets(
-                self.endpoint,
-                write_sets,
-                ["Put", hash32, {"c": compressed}, stored],
-                quorum=quorum,
-                prio=PRIO_NORMAL,
-            )
+            async with self.buffers.reserve(len(stored)):
+                await self.helper.try_write_many_sets(
+                    self.endpoint,
+                    write_sets,
+                    ["Put", hash32, {"c": compressed, "s": len(stored)}],
+                    quorum=quorum,
+                    prio=PRIO_NORMAL,
+                    stream_factory=lambda: bytes_stream(stored),
+                )
             return
         # EC: one distinct piece per node rank; pieces are not compressed
         # (parity shards don't compress; data shards rarely worth it)
@@ -337,17 +440,21 @@ class BlockManager:
                 f"{len(nodes)}"
             )
         targets = list(enumerate(nodes[: self.codec.n_pieces]))
-        results = await asyncio.gather(
-            *[
-                self.endpoint.call(
-                    n,
-                    ["Put", hash32, {"c": False, "p": i, "l": len(data)}, pieces[i]],
-                    prio=PRIO_NORMAL,
-                )
-                for i, n in targets
-            ],
-            return_exceptions=True,
-        )
+        async with self.buffers.reserve(sum(len(p) for p in pieces)):
+            results = await asyncio.gather(
+                *[
+                    self.endpoint.call(
+                        n,
+                        ["Put", hash32,
+                         {"c": False, "p": i, "l": len(data),
+                          "s": len(pieces[i])}],
+                        prio=PRIO_NORMAL,
+                        stream=bytes_stream(pieces[i]),
+                    )
+                    for i, n in targets
+                ],
+                return_exceptions=True,
+            )
         # quorum counts DISTINCT pieces stored; tolerate up to half the
         # parity pieces missing at write time (resync rebuilds them)
         distinct_ok = {
@@ -382,15 +489,18 @@ class BlockManager:
                     continue
                 try:
                     resp = await self.endpoint.call(n, ["Get", hash32], prio=prio)
-                    _ok, meta, stored = resp.body
-                    data = (
-                        zstandard.decompress(bytes(stored))
-                        if meta.get("c")
-                        else bytes(stored)
-                    )
-                    if blake2sum(data) != hash32:
-                        raise Error("hash mismatch from peer")
-                    return data
+                    declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
+                    # reserve before buffering; held through decompress+verify
+                    async with self.buffers.reserve(declared):
+                        meta, stored = await _resp_payload(resp)
+                        data = (
+                            zstandard.decompress(stored)
+                            if meta.get("c")
+                            else stored
+                        )
+                        if blake2sum(data) != hash32:
+                            raise Error("hash mismatch from peer")
+                        return data
                 except Exception as e:  # noqa: BLE001
                     errors.append(f"{n.hex()[:8]}: {e!r}")
             raise Error(f"block {hash32.hex()[:16]} unavailable: {errors}")
@@ -410,8 +520,7 @@ class BlockManager:
                 stored = zstandard.decompress(stored)
             return unwrap_piece(stored)
         resp = await self.endpoint.call(node, ["Get", hash32, piece], prio=prio)
-        _ok, meta, stored = resp.body
-        stored = bytes(stored)
+        meta, stored = await _resp_payload(resp, budget=self.buffers)
         if meta.get("c"):
             stored = zstandard.decompress(stored)
         return unwrap_piece(stored)

@@ -182,15 +182,20 @@ class BlockResyncManager:
                     if resp.body:
                         found = mgr.find_block_file(hash32)
                         if found:
+                            from ..net.stream import bytes_stream
+
                             path, compressed = found
                             with open(path, "rb") as f:
                                 stored = f.read()
-                            await mgr.endpoint.call(
-                                n,
-                                ["Put", hash32, {"c": compressed}, stored],
-                                prio=PRIO_BACKGROUND,
-                                timeout=120.0,
-                            )
+                            async with mgr.buffers.reserve(len(stored)):
+                                await mgr.endpoint.call(
+                                    n,
+                                    ["Put", hash32,
+                                     {"c": compressed, "s": len(stored)}],
+                                    prio=PRIO_BACKGROUND,
+                                    timeout=120.0,
+                                    stream=bytes_stream(stored),
+                                )
                 except Exception as e:
                     raise RuntimeError(
                         f"cannot verify/hand off to {n.hex()[:8]}: {e!r}"

@@ -2,10 +2,11 @@
 
 Mirrors reference src/db/lib.rs:28-121 (`IDb` / `ITx` trait objects): named
 trees of (bytes → bytes) with ordered range iteration and cross-tree
-transactions.  Engines: sqlite (stdlib; the reference ships LMDB + SQLite —
-LMDB has no Python binding in this image, so the second engine is an ordered
-in-memory map used for tests and ephemeral nodes).  The same test suite runs
-against every engine (reference src/db/test.rs:127-144 pattern).
+transactions.  Engines (the reference ships LMDB + SQLite,
+src/db/lmdb_adapter.rs + sqlite_adapter.rs): `sqlite` (stdlib), `log` — a
+durable log-structured engine filling the LMDB slot (log_engine.py), and
+`memory` for tests/ephemeral nodes.  The same test suite runs against every
+engine (reference src/db/test.rs:127-144 pattern).
 """
 
 from __future__ import annotations

@@ -14,8 +14,16 @@ def open_db(path: str, engine: str = "sqlite", fsync: bool = True) -> Db:
         if os.path.isdir(path) or not os.path.splitext(path)[1]:
             path = os.path.join(path, "db.sqlite")
         return SqliteDb(path, fsync=fsync)
+    if engine == "log":
+        from .log_engine import LogDb
+
+        if os.path.isdir(path) or not os.path.splitext(path)[1]:
+            path = os.path.join(path, "db.log")
+        return LogDb(path, fsync=fsync)
     if engine == "memory":
         from .memory_engine import MemDb
 
         return MemDb()
-    raise ValueError(f"unknown db engine {engine!r} (supported: sqlite, memory)")
+    raise ValueError(
+        f"unknown db engine {engine!r} (supported: sqlite, log, memory)"
+    )

@@ -126,6 +126,7 @@ class Garage:
             compression_level=config.compression_level,
             codec=codec,
             data_fsync=config.data_fsync,
+            ram_buffer_max=config.block_ram_buffer_max,
         )
 
         # tables, wired with their reactive cross-links
@@ -245,6 +246,10 @@ class Garage:
         resync = self.block_manager.resync
         reg("block_resync_queue_length", (), lambda: len(resync.queue))
         reg("block_resync_errored_blocks", (), lambda: len(resync.errors))
+        reg(
+            "block_ram_buffer_bytes", (),
+            lambda: self.block_manager.buffers.used,
+        )
         for t in self.tables:
             lbl = (("table_name", t.schema.table_name),)
             reg(

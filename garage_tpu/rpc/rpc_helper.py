@@ -55,9 +55,14 @@ class RpcHelper:
         msg: Any,
         prio: int = PRIO_NORMAL,
         timeout: float | None = None,
+        stream_factory=None,
     ):
+        """stream_factory() makes a FRESH attached byte stream per call —
+        required because an async iterator can only be consumed once but a
+        quorum write sends the same payload to several nodes."""
         return await endpoint.call(
-            node, msg, prio=prio, timeout=timeout or self.default_timeout
+            node, msg, prio=prio, timeout=timeout or self.default_timeout,
+            stream=stream_factory() if stream_factory else None,
         )
 
     async def call_many(
@@ -166,6 +171,7 @@ class RpcHelper:
         quorum: int,
         prio: int = PRIO_NORMAL,
         timeout: float | None = None,
+        stream_factory=None,
     ) -> None:
         """Write to the union of all sets; success when EVERY set has
         `quorum` successes.  Remaining in-flight requests are left running
@@ -204,7 +210,9 @@ class RpcHelper:
 
         async def one(n: bytes):
             try:
-                await self.call(endpoint, n, msg, prio, timeout)
+                await self.call(
+                    endpoint, n, msg, prio, timeout, stream_factory=stream_factory
+                )
                 for i, s in enumerate(write_sets):
                     if n in s:
                         set_success[i] += 1

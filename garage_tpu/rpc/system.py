@@ -257,6 +257,14 @@ class System:
         if not self.discovery:
             return
         my_addr = self.public_addr or self.netapp.bind_addr
+        if my_addr is not None and my_addr[0] in ("0.0.0.0", "::", ""):
+            # a wildcard bind address is meaningless to peers — publishing
+            # it would make everyone dial themselves
+            logger.warning(
+                "discovery: rpc_public_addr not set and bind address is "
+                "%s; not publishing this node", my_addr[0],
+            )
+            my_addr = None
         for d in self.discovery:
             try:
                 if my_addr is not None:

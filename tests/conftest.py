@@ -28,9 +28,10 @@ except Exception:
 import pytest  # noqa: E402
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "log"])
 def db(request, tmp_path):
-    """Dual-engine DB fixture: every db test runs against all engines
+    """Multi-engine DB fixture: every db test runs against all engines —
+    two durable (sqlite, log-structured) + memory
     (reference src/db/test.rs:127-144 pattern)."""
     from garage_tpu.db import open_db
 

@@ -211,6 +211,71 @@ def test_block_put_get(tmp_path):
     run(main())
 
 
+def test_ram_budget_bounds_concurrent_puts(tmp_path):
+    """The block_ram_buffer_max budget serializes payload buffers: total
+    reserved bytes never exceed the limit, and everything completes."""
+
+    async def main():
+        from garage_tpu.block.manager import ByteBudget
+
+        budget = ByteBudget(100_000)
+        peak = 0
+        done = 0
+
+        async def one(n):
+            nonlocal peak, done
+            async with budget.reserve(40_000):
+                peak = max(peak, budget.used)
+                await asyncio.sleep(0.01)
+                done += 1
+
+        await asyncio.gather(*[one(i) for i in range(10)])
+        assert done == 10
+        assert peak <= 100_000, f"budget exceeded: {peak}"
+        assert budget.used == 0
+
+        # an oversized single item is clamped, not deadlocked
+        async with budget.reserve(10**9):
+            assert budget.used == budget.limit
+        assert budget.used == 0
+
+    run(main())
+
+
+def test_put_payloads_ride_streams(tmp_path):
+    """Block payloads must travel as attached streams, not msgpack bodies
+    (zero-copy path): the Put body carries no payload element."""
+
+    async def main():
+        apps, systems, managers = await make_block_cluster(tmp_path)
+        try:
+            seen_bodies = []
+            orig = managers[1].endpoint.handler
+
+            async def spy(from_id, req):
+                seen_bodies.append(req.body)
+                return await orig(from_id, req)
+
+            managers[1].endpoint.set_handler(spy)
+            data = os.urandom(80_000)
+            h = blake2sum(data)
+            await managers[0].rpc_put_block(h, data)
+            await asyncio.sleep(0.2)
+            puts = [b for b in seen_bodies if b[0] == "Put"]
+            assert puts, "no Put seen by replica"
+            assert all(len(b) == 3 for b in puts), (
+                "Put body carries an inline payload; expected streamed"
+            )
+            assert managers[1].has_block(h)
+            # Get responses stream too (and still verify end-to-end)
+            got = await managers[0].rpc_get_block(h)
+            assert got == data
+        finally:
+            await stop_all(apps, systems)
+
+    run(main())
+
+
 def test_block_corruption_detected(tmp_path):
     async def main():
         apps, systems, managers = await make_block_cluster(tmp_path)

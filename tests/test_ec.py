@@ -161,3 +161,26 @@ def test_native_matches_reference():
     got = _native.blake3_batch(batch)
     for i in range(7):
         assert bytes(got[i]) == py_blake3(bytes(batch[i]))
+
+
+def test_pallas_kernel_lowers_for_tpu():
+    """AOT cross-lowering for the TPU platform (jax.export) must succeed
+    for both MXU dtypes and for encode + repair matrix shapes — catches
+    Mosaic lowering regressions without TPU hardware."""
+    import jax
+    import jax.numpy as jnp
+
+    from garage_tpu.ops.ec_tpu import gf_bitmatmul_pallas
+
+    k, m = 8, 3
+    enc = jnp.asarray(gf.bitmatrix_of(gf.cauchy_parity_matrix(k, m)), jnp.uint8)
+    rmat = gf.reconstruction_matrix(k, m, list(range(m, k + m))[:k], list(range(m)))
+    rec = jnp.asarray(gf.bitmatrix_of(rmat), jnp.uint8)
+    x = jnp.zeros((4, k, 16384), jnp.uint8)
+    for dd in ("int8", "bf16"):
+        for bm in (enc, rec):
+            exported = jax.export.export(
+                jax.jit(lambda b, xx, _dd=dd: gf_bitmatmul_pallas(b, xx, dot_dtype=_dd)),
+                platforms=["tpu"],
+            )(bm, x)
+            assert exported.out_avals[0].shape == (4, bm.shape[0] // 8, 16384)

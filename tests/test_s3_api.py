@@ -456,12 +456,14 @@ def test_upload_part_copy_cross_encryption(tmp_path):
     import base64
     import hashlib as _hl
 
-    def ssec_headers(key: bytes, prefix=""):
+    def ssec_headers(key: bytes, copy_source=False):
+        # AWS spec: the copy-source variant REPLACES the leading "x-amz-"
+        pfx = "x-amz-copy-source-" if copy_source else "x-amz-"
         return {
-            f"{prefix}x-amz-server-side-encryption-customer-algorithm": "AES256",
-            f"{prefix}x-amz-server-side-encryption-customer-key":
+            f"{pfx}server-side-encryption-customer-algorithm": "AES256",
+            f"{pfx}server-side-encryption-customer-key":
                 base64.b64encode(key).decode(),
-            f"{prefix}x-amz-server-side-encryption-customer-key-md5":
+            f"{pfx}server-side-encryption-customer-key-md5":
                 base64.b64encode(_hl.md5(key).digest()).decode(),
         }
 
@@ -481,7 +483,7 @@ def test_upload_part_copy_cross_encryption(tmp_path):
             # note: dest has NO encryption, source is encrypted with key A
             e1 = await client.upload_part_copy(
                 "xenc", "enc-dst.bin", uid, 1, "xenc", "enc-src.bin",
-                headers=ssec_headers(key_a, prefix="x-amz-copy-source-"),
+                headers=ssec_headers(key_a, copy_source=True),
             )
             await client.complete_multipart_upload("xenc", "enc-dst.bin", uid, [(1, e1)])
             assert await client.get_object("xenc", "enc-dst.bin") == src
