@@ -100,16 +100,30 @@ def child_main(args) -> None:
         bitmat = jax.device_put(jnp.asarray(gf.bitmatrix_of(mat), jnp.uint8), dev)
 
         # Try the fused Pallas kernel first; fall back to the portable
-        # einsum path if the backend can't lower it.  (On CPU the Pallas
-        # path only exists in interpreter mode — go straight to einsum.)
+        # einsum path if the backend can't lower it.  On CPU the native
+        # C++ LUT codec is the framework's real encode path (the Pallas
+        # kernel only exists in interpreter mode there).
         if args.impl:
             impls = [args.impl]
         elif dev.platform == "cpu":
-            impls = ["einsum"]
+            impls = ["native", "einsum"]
         else:
             impls = ["pallas_int8", "pallas_bf16", "einsum"]
         run = None
         for impl in impls:
+            if impl == "native":
+                from garage_tpu import _native
+
+                if _native.available():
+                    def run(x, _mat=mat, _np=data):
+                        for b in range(_np.shape[0]):
+                            out = _native.gf8_apply(_mat, _np[b])
+                        return out
+
+                    if args.verbose:
+                        print("# impl=native (C++ host codec)", file=sys.stderr)
+                    break
+                continue
             try:
                 apply_fn = ec_apply_fn(None, impl)
                 out = apply_fn(bitmat, data_dev)

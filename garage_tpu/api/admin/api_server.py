@@ -56,6 +56,20 @@ class AdminApiServer:
         try:
             if path == "/health":
                 return self._health()
+            if path == "/check":
+                # reverse-proxy hook (e.g. on-demand TLS): is this domain
+                # served by the cluster?  (reference api_server.rs:79-137)
+                domain = request.query.get("domain")
+                if not domain:
+                    return web.Response(status=400, text="no domain query")
+                if await self._check_domain(domain):
+                    return web.Response(
+                        text=f"Domain '{domain}' is managed by garage-tpu"
+                    )
+                return web.Response(
+                    status=400,
+                    text=f"Domain '{domain}' is not managed by garage-tpu",
+                )
             if path == "/metrics":
                 if self.metrics_token and not (
                     self._check_token(request, self.metrics_token)
@@ -71,6 +85,41 @@ class AdminApiServer:
             return web.json_response({"error": repr(e)}, status=500)
 
     # --- public endpoints -----------------------------------------------------
+
+    async def _check_domain(self, domain: str) -> bool:
+        """Domain -> bucket: under the S3 root_domain any existing bucket
+        counts; under the web root_domain (or as a bare vhost) the bucket
+        must have website access enabled (reference api_server.rs:116-137)."""
+        from ...utils.error import Error
+
+        g = self.garage
+
+        def strip(rd: str | None) -> str | None:
+            # label-boundary match, leading dot optional in the config —
+            # same normalization as the S3/web vhost routing
+            if not rd:
+                return None
+            rd = rd.lstrip(".")
+            if domain.endswith("." + rd) and len(domain) > len(rd) + 1:
+                return domain[: -(len(rd) + 1)]
+            return None
+
+        bname = strip(g.config.s3_api.root_domain)
+        must_website = False
+        if bname is None:
+            bname = strip(g.config.s3_web.root_domain)
+            must_website = True
+            if bname is None:
+                bname = domain  # vhost-style: the domain IS the bucket name
+        try:
+            bucket = await g.helper.get_bucket(
+                await g.helper.resolve_bucket(bname)
+            )
+        except Error:
+            return False
+        if must_website:
+            return bucket.params().website.get() is not None
+        return True
 
     def _health(self) -> web.Response:
         h = self.garage.system.health()

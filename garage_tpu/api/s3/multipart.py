@@ -327,15 +327,26 @@ async def handle_abort_multipart_upload(garage, bucket_id, key, request):
 
 async def handle_list_parts(garage, bucket_id, key, request):
     mpu = await _get_mpu(garage, bucket_id, key, request.query.get("uploadId", ""))
+    # pagination (reference list.rs ListParts state machine):
+    # part-number-marker is exclusive, max-parts caps the page
+    q = request.query
+    max_parts = max(1, min(int(q.get("max-parts", "1000")), 1000))
+    marker = int(q.get("part-number-marker", "0"))
     parts = mpu.latest_parts()
+    pns = [pn for pn in sorted(parts) if pn > marker]
+    page, rest = pns[:max_parts], pns[max_parts:]
     children = [
         ("Bucket", ""),
         ("Key", key),
         ("UploadId", mpu.upload_id.hex()),
         ("StorageClass", "STANDARD"),
-        ("IsTruncated", False),
+        ("MaxParts", max_parts),
+        ("PartNumberMarker", marker) if marker else None,
+        ("IsTruncated", bool(rest)),
     ]
-    for pn in sorted(parts):
+    if rest:
+        children.append(("NextPartNumberMarker", page[-1]))
+    for pn in page:
         p = parts[pn]
         children.append(
             (
@@ -353,22 +364,104 @@ async def handle_list_parts(garage, bucket_id, key, request):
 
 
 async def handle_list_multipart_uploads(garage, bucket_id, bucket_name, request):
-    # in-flight uploads = objects with an uploading mpu version
-    objs = await garage.object_table.get_range(bucket_id, None, None, 1000)
-    children = [("Bucket", bucket_name), ("IsTruncated", False)]
-    for o in objs:
-        for v in o.versions:
-            if v.state == "uploading" and v.data.get("mpu"):
-                children.append(
-                    (
-                        "Upload",
-                        [
-                            ("Key", o.key),
-                            ("UploadId", v.uuid.hex()),
-                            ("StorageClass", "STANDARD"),
-                        ],
-                    )
-                )
+    """In-flight uploads = objects holding an uploading mpu version.
+    One paginated pass over (key, upload_id) with prefix/delimiter folding
+    (reference list.rs ListMultipartUploads state machine); the object
+    table is scanned only as far as the page needs."""
+    q = request.query
+    prefix = q.get("prefix", "")
+    delimiter = q.get("delimiter", "")
+    max_uploads = max(1, min(int(q.get("max-uploads", "1000")), 1000))
+    key_marker = q.get("key-marker", "")
+    uid_marker = q.get("upload-id-marker", "")
+
+    uploads: list[tuple[str, str]] = []
+    prefixes: list[str] = []
+    # the last entry emitted IN SORT ORDER — uploads and prefixes
+    # interleave, so the continuation marker must track both kinds
+    last_emitted: tuple[str, str | None] | None = None
+    truncated = False
+
+    def page_full() -> bool:
+        return len(uploads) + len(prefixes) >= max_uploads
+
+    cursor = max(key_marker, prefix).encode() if (key_marker or prefix) else None
+    done = False
+    while not done:
+        objs = await garage.object_table.get_range(bucket_id, cursor, None, 1000)
+        if not objs:
+            break
+        for o in objs:
+            k = o.key
+            if prefix and not k.startswith(prefix):
+                if k > prefix:
+                    done = True
+                    break
+                continue
+            pairs = sorted(
+                (k, v.uuid.hex())
+                for v in o.versions
+                if v.state == "uploading" and v.data.get("mpu")
+            )
+            for k, uid in pairs:
+                # markers: exclusive on key alone, or on (key, upload_id)
+                # when an upload-id-marker narrows within the key
+                if uid_marker:
+                    if k < key_marker or (k == key_marker and uid <= uid_marker):
+                        continue
+                elif key_marker and k <= key_marker:
+                    continue
+                if delimiter and delimiter in k[len(prefix):]:
+                    cp = prefix + k[len(prefix):].split(delimiter)[0] + delimiter
+                    # a CommonPrefix consumes its whole group
+                    if cp <= key_marker or (prefixes and prefixes[-1] == cp):
+                        continue
+                    if page_full():
+                        truncated, done = True, True
+                        break
+                    prefixes.append(cp)
+                    last_emitted = (cp, None)
+                    continue
+                if page_full():
+                    truncated, done = True, True
+                    break
+                uploads.append((k, uid))
+                last_emitted = (k, uid)
+            if done:
+                break
+        else:
+            if len(objs) < 1000:
+                break
+            cursor = objs[-1].key.encode() + b"\x00"
+            continue
+        break
+
+    children = [
+        ("Bucket", bucket_name),
+        ("Prefix", prefix),
+        ("Delimiter", delimiter) if delimiter else None,
+        ("KeyMarker", key_marker) if key_marker else None,
+        ("UploadIdMarker", uid_marker) if uid_marker else None,
+        ("MaxUploads", max_uploads),
+        ("IsTruncated", truncated),
+    ]
+    if truncated and last_emitted is not None:
+        children.append(("NextKeyMarker", last_emitted[0]))
+        if last_emitted[1] is not None:
+            children.append(("NextUploadIdMarker", last_emitted[1]))
+    for k, uid in uploads:
+        children.append(
+            (
+                "Upload",
+                [
+                    ("Key", k),
+                    ("UploadId", uid),
+                    ("StorageClass", "STANDARD"),
+                ],
+            )
+        )
+    for cp in prefixes:
+        children.append(("CommonPrefixes", [("Prefix", cp)]))
     return web.Response(
         text=xml_doc("ListMultipartUploadsResult", children),
         content_type="application/xml",

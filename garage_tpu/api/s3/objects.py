@@ -469,6 +469,19 @@ async def handle_get_object(
     if enc_params is not None:
         headers.update(enc_params.response_headers())
 
+    # response-* query overrides (reference get.rs:100-117): the signed
+    # request may rewrite presentation headers
+    for qname, hname in (
+        ("response-cache-control", "Cache-Control"),
+        ("response-content-disposition", "Content-Disposition"),
+        ("response-content-encoding", "Content-Encoding"),
+        ("response-content-language", "Content-Language"),
+        ("response-content-type", "Content-Type"),
+        ("response-expires", "Expires"),
+    ):
+        if qname in request.query:
+            headers[hname] = request.query[qname]
+
     part_number = _parse_part_number(request)
     is_inline = version.data.get("t") == "inline"
     blocks = None

@@ -369,6 +369,54 @@ class AdminRpcHandler:
         )
         return "revoked"
 
+    async def op_bucket_website(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["bucket"])
+        b = await self.garage.helper.get_bucket(bid)
+        if args.get("allow"):
+            b.params().website.update(
+                {
+                    "index_document": args.get("index_document") or "index.html",
+                    "error_document": args.get("error_document"),
+                }
+            )
+        else:
+            b.params().website.update(None)
+        await self.garage.bucket_table.insert(b)
+        return "website " + ("enabled" if args.get("allow") else "disabled")
+
+    async def op_bucket_quota(self, args) -> Any:
+        """Only the quotas present in `args` change; absent keys keep their
+        current value (None clears one explicitly)."""
+        bid = await self.garage.helper.resolve_bucket(args["bucket"])
+        b = await self.garage.helper.get_bucket(bid)
+        q = dict(b.params().quotas.get() or {})
+        for field in ("max_size", "max_objects"):
+            if field in args:
+                q[field] = args[field]
+        b.params().quotas.update(q)
+        await self.garage.bucket_table.insert(b)
+        return "quotas updated"
+
+    async def op_bucket_alias(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["bucket"])
+        if args.get("local_key"):
+            await self.garage.helper.set_local_alias(
+                bid, args["local_key"], args["alias"]
+            )
+        else:
+            await self.garage.helper.set_global_alias(bid, args["alias"])
+        return "alias added"
+
+    async def op_bucket_unalias(self, args) -> Any:
+        bid = await self.garage.helper.resolve_bucket(args["bucket"])
+        if args.get("local_key"):
+            await self.garage.helper.unset_local_alias(
+                bid, args["local_key"], args["alias"]
+            )
+        else:
+            await self.garage.helper.unset_global_alias(bid, args["alias"])
+        return "alias removed"
+
     # --- keys -----------------------------------------------------------------
 
     async def op_key_new(self, args) -> Any:
@@ -399,6 +447,24 @@ class AdminRpcHandler:
     async def op_key_delete(self, args) -> Any:
         await self.garage.helper.delete_key(args["key"])
         return "deleted"
+
+    async def op_key_import(self, args) -> Any:
+        k = await self.garage.helper.import_key(
+            args["key_id"], args["secret"], args.get("name", "")
+        )
+        return {"key_id": k.key_id}
+
+    async def op_key_set(self, args) -> Any:
+        k = await self.garage.helper.update_key(
+            args["key"],
+            name=args.get("name"),
+            allow_create_bucket=args.get("allow_create_bucket"),
+        )
+        return {
+            "key_id": k.key_id,
+            "name": k.params().name.get(),
+            "allow_create_bucket": bool(k.params().allow_create_bucket.get()),
+        }
 
     # --- workers / repair -----------------------------------------------------
 

@@ -102,6 +102,25 @@ def main(argv=None):
     dny = bkt_sub.add_parser("deny")
     dny.add_argument("bucket")
     dny.add_argument("--key", required=True)
+    web_p = bkt_sub.add_parser("website")
+    web_p.add_argument("bucket")
+    grp = web_p.add_mutually_exclusive_group(required=True)
+    grp.add_argument("--allow", action="store_true")
+    grp.add_argument("--deny", action="store_true")
+    web_p.add_argument("--index-document", default="index.html")
+    web_p.add_argument("--error-document")
+    quo = bkt_sub.add_parser("quota")
+    quo.add_argument("bucket")
+    quo.add_argument("--max-size", help="bytes or 100G etc; 'none' clears")
+    quo.add_argument("--max-objects", help="count; 'none' clears")
+    ali = bkt_sub.add_parser("alias")
+    ali.add_argument("bucket")
+    ali.add_argument("alias")
+    ali.add_argument("--local", help="key id: make a key-local alias")
+    una = bkt_sub.add_parser("unalias")
+    una.add_argument("bucket")
+    una.add_argument("alias")
+    una.add_argument("--local", help="key id: remove a key-local alias")
 
     key = sub.add_parser("key")
     key_sub = key.add_subparsers(dest="key_cmd", required=True)
@@ -114,6 +133,16 @@ def main(argv=None):
     kinf.add_argument("--show-secret", action="store_true")
     kdel = key_sub.add_parser("delete")
     kdel.add_argument("key")
+    kimp = key_sub.add_parser("import")
+    kimp.add_argument("key_id")
+    kimp.add_argument("secret")
+    kimp.add_argument("--name", default="imported")
+    kset = key_sub.add_parser("set")
+    kset.add_argument("key")
+    kset.add_argument("--name")
+    acb = kset.add_mutually_exclusive_group()
+    acb.add_argument("--allow-create-bucket", action="store_true", default=None)
+    acb.add_argument("--deny-create-bucket", action="store_true", default=None)
 
     wrk = sub.add_parser("worker")
     wrk.add_argument("worker_cmd", choices=["list", "get", "set"])
@@ -459,6 +488,41 @@ async def dispatch(args, call, config) -> str | None:
             )
         if bc == "deny":
             return str(await call("bucket-deny", {"bucket": args.bucket, "key": args.key}))
+        if bc == "website":
+            return str(
+                await call(
+                    "bucket-website",
+                    {
+                        "bucket": args.bucket,
+                        "allow": args.allow,
+                        "index_document": args.index_document,
+                        "error_document": args.error_document,
+                    },
+                )
+            )
+        if bc == "quota":
+            # only send the quotas the operator named; absent = unchanged
+            a = {"bucket": args.bucket}
+            if args.max_size is not None:
+                a["max_size"] = (
+                    None if args.max_size == "none" else _parse_capacity(args.max_size)
+                )
+            if args.max_objects is not None:
+                a["max_objects"] = (
+                    None if args.max_objects == "none" else int(args.max_objects)
+                )
+            return str(await call("bucket-quota", a))
+        if bc in ("alias", "unalias"):
+            return str(
+                await call(
+                    f"bucket-{bc}",
+                    {
+                        "bucket": args.bucket,
+                        "alias": args.alias,
+                        "local_key": args.local,
+                    },
+                )
+            )
 
     if args.cmd == "key":
         kc = args.key_cmd
@@ -481,6 +545,25 @@ async def dispatch(args, call, config) -> str | None:
             )
         if kc == "delete":
             return str(await call("key-delete", {"key": args.key}))
+        if kc == "import":
+            r = await call(
+                "key-import",
+                {"key_id": args.key_id, "secret": args.secret, "name": args.name},
+            )
+            return f"imported {r['key_id']}"
+        if kc == "set":
+            acb = None
+            if args.allow_create_bucket:
+                acb = True
+            elif args.deny_create_bucket:
+                acb = False
+            return json.dumps(
+                await call(
+                    "key-set",
+                    {"key": args.key, "name": args.name,
+                     "allow_create_bucket": acb},
+                )
+            )
 
     if args.cmd == "worker" and args.worker_cmd == "get":
         return json.dumps(await call("worker-get", {"var": args.var}))

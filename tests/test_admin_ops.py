@@ -302,3 +302,114 @@ def test_admin_api_bucket_key_crud(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_bucket_and_key_admin_ops(tmp_path):
+    """bucket website/quota/alias/unalias + key import/set through the
+    admin RPC (the CLI's backend), and the public /check endpoint."""
+
+    async def main():
+        import aiohttp
+
+        from garage_tpu.api.admin.api_server import AdminApiServer
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        adm = AdminRpcHandler(garage)
+        aapi = AdminApiServer(garage)
+        await aapi.start("127.0.0.1", 0)
+        port = aapi.runner.addresses[0][1]
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("site")
+
+            # website toggle
+            out = await rpc(adm, "bucket-website",
+                            {"bucket": "site", "allow": True,
+                             "index_document": "home.htm"})
+            assert "enabled" in out
+            bid = await garage.helper.resolve_bucket("site")
+            b = await garage.helper.get_bucket(bid)
+            assert b.params().website.get()["index_document"] == "home.htm"
+
+            # /check: bare vhost needs website on; web root_domain too
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/check", params={"domain": "site"}
+                ) as r:
+                    assert r.status == 200
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/check",
+                    params={"domain": "site.web.garage"},
+                ) as r:
+                    assert r.status == 200  # default web root_domain
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/check", params={"domain": "nope"}
+                ) as r:
+                    assert r.status == 400
+                async with sess.get(f"http://127.0.0.1:{port}/check") as r:
+                    assert r.status == 400
+
+            await rpc(adm, "bucket-website", {"bucket": "site", "allow": False})
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/check", params={"domain": "site"}
+                ) as r:
+                    assert r.status == 400  # website off again
+
+            # quotas
+            await rpc(adm, "bucket-quota",
+                      {"bucket": "site", "max_size": 1000, "max_objects": 2})
+            b = await garage.helper.get_bucket(bid)
+            assert b.params().quotas.get() == {"max_size": 1000, "max_objects": 2}
+
+            # aliases via admin rpc
+            await rpc(adm, "bucket-alias", {"bucket": "site", "alias": "alt-name"})
+            assert await garage.helper.resolve_bucket("alt-name") == bid
+            await rpc(adm, "bucket-unalias", {"bucket": "site", "alias": "alt-name"})
+            import pytest as _pytest
+
+            from garage_tpu.utils.error import Error as _Err
+
+            with _pytest.raises(_Err):
+                await garage.helper.resolve_bucket("alt-name")
+
+            # key import + set
+            r = await rpc(adm, "key-import",
+                          {"key_id": "GK" + "12" * 12, "secret": "ef" * 32,
+                           "name": "imp"})
+            assert r["key_id"] == "GK" + "12" * 12
+            r = await rpc(adm, "key-set",
+                          {"key": "GK" + "12" * 12, "name": "renamed",
+                           "allow_create_bucket": True})
+            assert r["allow_create_bucket"] is True and r["name"] == "renamed"
+        finally:
+            await aapi.stop()
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_bucket_quota_partial_update_preserves_other(tmp_path):
+    """Updating one quota must not silently clear the other."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        adm = AdminRpcHandler(garage)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("quotabkt")
+            await rpc(adm, "bucket-quota",
+                      {"bucket": "quotabkt", "max_size": 5000, "max_objects": 7})
+            # only max_size named: max_objects must survive
+            await rpc(adm, "bucket-quota", {"bucket": "quotabkt", "max_size": 9000})
+            bid = await garage.helper.resolve_bucket("quotabkt")
+            b = await garage.helper.get_bucket(bid)
+            assert b.params().quotas.get() == {"max_size": 9000, "max_objects": 7}
+            # explicit None clears just that one
+            await rpc(adm, "bucket-quota", {"bucket": "quotabkt", "max_size": None})
+            b = await garage.helper.get_bucket(bid)
+            assert b.params().quotas.get() == {"max_size": None, "max_objects": 7}
+        finally:
+            await teardown(garage, s3)
+
+    run(main())

@@ -362,3 +362,68 @@ def test_dvvs_delete_sticks_on_stale_replica():
     # anti-entropy later merges node 1's value into B
     stale.merge(full)
     assert stale.is_tombstone(), "deleted value resurrected on stale replica"
+
+
+def test_k2v_cli(tmp_path):
+    """The k2v command-line client end to end against a live daemon (the
+    CLI runs in a worker thread with its own event loop, HTTP to the
+    daemon's loop)."""
+    import base64 as _b64
+    import contextlib
+    import io
+    import json as _json
+
+    from garage_tpu.k2v_client.__main__ import main as k2v_main
+
+    async def main():
+        garage, s3, k2v, client = await k2v_daemon(tmp_path)
+        await client.close()
+        try:
+            port = k2v.runner.addresses[0][1]
+            ks = await garage.helper.list_keys()
+            key = ks[0]
+            base = [
+                "--endpoint", f"http://127.0.0.1:{port}",
+                "--bucket", "k2vtest",
+                "--key-id", key.key_id,
+                "--secret", key.secret(),
+            ]
+
+            async def cli(*args):
+                out = io.StringIO()
+
+                def _invoke():
+                    with contextlib.redirect_stdout(out):
+                        return k2v_main(base + list(args))
+
+                rc = await asyncio.to_thread(_invoke)
+                return rc, out.getvalue()
+
+            rc, _ = await cli("insert", "room", "m1", "hello-cli")
+            assert rc == 0
+            rc, out = await cli("read", "room", "m1", "--json")
+            assert rc == 0
+            doc = _json.loads(out)
+            assert [_b64.b64decode(v) for v in doc["values"]] == [b"hello-cli"]
+            tok = doc["causality"]
+            # index counters land via the insert-queue worker: retry
+            for _ in range(100):
+                rc, out = await cli("read-index")
+                assert rc == 0
+                idx = _json.loads(out)
+                if any(p["pk"] == "room" for p in idx["partitionKeys"]):
+                    break
+                await asyncio.sleep(0.1)
+            assert any(p["pk"] == "room" for p in idx["partitionKeys"])
+            rc, out = await cli("read-range", "room")
+            assert rc == 0
+            assert [i["sk"] for i in _json.loads(out)["items"]] == ["m1"]
+            rc, _ = await cli("delete", "room", "m1", "-c", tok)
+            assert rc == 0
+            rc, _ = await cli("read", "room", "m1")
+            assert rc == 1  # gone
+        finally:
+            await k2v.stop()
+            await teardown(garage, s3)
+
+    run(main())

@@ -306,6 +306,116 @@ def test_multipart_upload(tmp_path):
     run(main())
 
 
+def test_listing_pagination_edge_cases(tmp_path):
+    """V2 pagination with max-keys=1 over keys + common prefixes, V1
+    NextMarker, ListParts part-number-marker, ListMultipartUploads
+    key/upload-id markers + delimiter folding + max-uploads=1 paging."""
+    import xml.etree.ElementTree as ET
+
+    ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("page")
+            keys = ["a.txt", "dir/x1", "dir/x2", "dirz", "e.txt"]
+            for k in keys:
+                await client.put_object("page", k, b"v")
+
+            # V2: walk the whole listing one entry at a time with delimiter
+            got, token = [], None
+            for _ in range(10):
+                res = await client.list_objects_v2(
+                    "page", delimiter="/", max_keys=1, continuation_token=token
+                )
+                got += [k["key"] for k in res["keys"]] + res["common_prefixes"]
+                token = res["next_token"]
+                if not res["truncated"]:
+                    break
+            assert got == ["a.txt", "dir/", "dirz", "e.txt"]
+
+            # V1: NextMarker resumes without dropping or repeating keys
+            st, _h, data = await client._req(
+                "GET", "/page", query=[("max-keys", "2")]
+            )
+            root = ET.fromstring(data.decode())
+            assert root.findtext("s3:IsTruncated", namespaces=ns) == "true"
+            marker = root.findtext("s3:NextMarker", namespaces=ns)
+            first = [c.findtext("s3:Key", namespaces=ns)
+                     for c in root.findall("s3:Contents", ns)]
+            st, _h, data = await client._req(
+                "GET", "/page", query=[("marker", marker)]
+            )
+            root = ET.fromstring(data.decode())
+            rest = [c.findtext("s3:Key", namespaces=ns)
+                    for c in root.findall("s3:Contents", ns)]
+            assert first + rest == keys
+
+            # ListParts pagination
+            uid = await client.create_multipart_upload("page", "mp.bin")
+            etags = {}
+            for pn in (1, 3, 7):
+                etags[pn] = await client.upload_part(
+                    "page", "mp.bin", uid, pn, os.urandom(4000)
+                )
+            st, _h, data = await client._req(
+                "GET", "/page/mp.bin",
+                query=[("uploadId", uid), ("max-parts", "2")],
+            )
+            root = ET.fromstring(data.decode())
+            assert root.findtext("s3:IsTruncated", namespaces=ns) == "true"
+            assert root.findtext("s3:NextPartNumberMarker", namespaces=ns) == "3"
+            assert [p.findtext("s3:PartNumber", namespaces=ns)
+                    for p in root.findall("s3:Part", ns)] == ["1", "3"]
+            st, _h, data = await client._req(
+                "GET", "/page/mp.bin",
+                query=[("uploadId", uid), ("part-number-marker", "3")],
+            )
+            root = ET.fromstring(data.decode())
+            assert [p.findtext("s3:PartNumber", namespaces=ns)
+                    for p in root.findall("s3:Part", ns)] == ["7"]
+            assert root.findtext("s3:IsTruncated", namespaces=ns) == "false"
+
+            # ListMultipartUploads: several in-flight uploads incl. two on
+            # the SAME key (upload-id-marker must disambiguate), plus a
+            # delimiter fold
+            uids = {}
+            for k in ("up/a", "up/a", "vdir/sub", "w"):
+                u = await client.create_multipart_upload("page", k)
+                uids.setdefault(k, []).append(u)
+            seen, km, um = [], None, None
+            for _ in range(10):
+                q = [("uploads", ""), ("max-uploads", "1"), ("delimiter", "/"),]
+                if km:
+                    q.append(("key-marker", km))
+                if um:
+                    q.append(("upload-id-marker", um))
+                st, _h, data = await client._req("GET", "/page", query=q)
+                root = ET.fromstring(data.decode())
+                for u in root.findall("s3:Upload", ns):
+                    seen.append(
+                        (u.findtext("s3:Key", namespaces=ns),
+                         u.findtext("s3:UploadId", namespaces=ns))
+                    )
+                for cp in root.findall("s3:CommonPrefixes", ns):
+                    seen.append((cp.findtext("s3:Prefix", namespaces=ns), None))
+                if root.findtext("s3:IsTruncated", namespaces=ns) != "true":
+                    break
+                km = root.findtext("s3:NextKeyMarker", namespaces=ns)
+                um = root.findtext("s3:NextUploadIdMarker", namespaces=ns)
+            # mp.bin upload + folded up/ + folded vdir/ + w
+            flat_keys = [k for k, _ in seen]
+            assert flat_keys.count("up/") == 1 and flat_keys.count("vdir/") == 1
+            assert "w" in flat_keys and "mp.bin" in flat_keys
+            w_uploads = [u for k, u in seen if k == "w"]
+            assert w_uploads == [uids["w"][0]]
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
 def test_conditional_request_headers(tmp_path):
     """If-(None-)Match + If-(Un)Modified-Since with RFC 7232 precedence."""
 
@@ -345,6 +455,37 @@ def test_conditional_request_headers(tmp_path):
                 {"If-Match": f'"{etag}"', "If-Unmodified-Since": past}
             )
             assert st == 200
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_response_header_overrides(tmp_path):
+    """response-content-type & friends rewrite GET response headers
+    (reference get.rs:100-117)."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("ovr")
+            await client.put_object("ovr", "doc", b"data", "text/plain")
+            st, h, data = await client._req(
+                "GET", "/ovr/doc",
+                query=[
+                    ("response-content-type", "application/x-custom"),
+                    ("response-content-disposition", 'attachment; filename="d.bin"'),
+                    ("response-cache-control", "no-store"),
+                ],
+            )
+            assert st == 200 and data == b"data"
+            assert h["Content-Type"] == "application/x-custom"
+            assert h["Content-Disposition"] == 'attachment; filename="d.bin"'
+            assert h["Cache-Control"] == "no-store"
+            # without overrides the stored content-type comes back
+            st, h, _ = await client._req("GET", "/ovr/doc")
+            assert h["Content-Type"] == "text/plain"
         finally:
             await teardown(garage, s3)
 
@@ -1254,6 +1395,44 @@ def test_streaming_trailer_checksum(tmp_path):
             # wrong trailer value -> 400 BadDigest
             st, text = await send("/trailers/bad.bin", "AAAAAA==")
             assert st == 400 and "BadDigest" in text
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_list_uploads_prefix_marker_no_duplicates(tmp_path):
+    """A page ending on an Upload followed by a CommonPrefix page must not
+    re-emit entries (NextKeyMarker tracks the last entry in sort order)."""
+    import xml.etree.ElementTree as ET
+
+    ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("lmu")
+            for k in ("a", "dir/x", "dir/y", "z"):
+                await client.create_multipart_upload("lmu", k)
+            seen, km, um = [], None, None
+            for _ in range(8):
+                q = [("uploads", ""), ("max-uploads", "2"), ("delimiter", "/")]
+                if km:
+                    q.append(("key-marker", km))
+                if um:
+                    q.append(("upload-id-marker", um))
+                st, _h, data = await client._req("GET", "/lmu", query=q)
+                root = ET.fromstring(data.decode())
+                seen += [u.findtext("s3:Key", namespaces=ns)
+                         for u in root.findall("s3:Upload", ns)]
+                seen += [p.findtext("s3:Prefix", namespaces=ns)
+                         for p in root.findall("s3:CommonPrefixes", ns)]
+                if root.findtext("s3:IsTruncated", namespaces=ns) != "true":
+                    break
+                km = root.findtext("s3:NextKeyMarker", namespaces=ns)
+                um = root.findtext("s3:NextUploadIdMarker", namespaces=ns)
+            assert seen == ["a", "dir/", "z"], f"duplicates/misorder: {seen}"
         finally:
             await teardown(garage, s3)
 
