@@ -17,7 +17,6 @@ from ...model.s3.block_ref_table import BlockRef
 from ...model.s3.object_table import Object, ObjectVersion
 from ...model.s3.version_table import Version
 from ...utils.data import gen_uuid
-from ...utils.time_util import now_msec
 from ..common.error import BadRequest, NoSuchKey
 from .objects import handle_delete_object
 from .xml_util import http_iso as _http_iso, xml_doc
@@ -50,9 +49,12 @@ async def resolve_copy_source(garage, helper, api_key, request):
 
 
 async def handle_copy_object(garage, helper, api_key, dest_bucket_id, dest_key, request):
+    from .objects import next_timestamp
+
     sv = await resolve_copy_source(garage, helper, api_key, request)
     meta = dict(sv.data.get("meta", {}))
-    ts = now_msec()
+    dest_existing = await garage.object_table.get(dest_bucket_id, dest_key.encode())
+    ts = next_timestamp(dest_existing)
     new_uuid = gen_uuid()
 
     if sv.data.get("t") == "inline":

@@ -30,6 +30,8 @@ from .xml_util import xml_doc
 async def handle_create_multipart_upload(garage, bucket_id, key, request):
     from .encryption import EncryptionParams
 
+    from .objects import next_timestamp
+
     enc = EncryptionParams.from_headers(request.headers)
     upload_id = gen_uuid()
     headers = [
@@ -37,8 +39,9 @@ async def handle_create_multipart_upload(garage, bucket_id, key, request):
         for h, v in request.headers.items()
         if h.lower() in SAVED_HEADERS
     ]
+    existing = await garage.object_table.get(bucket_id, key.encode())
     mpu = MultipartUpload(
-        upload_id, bucket_id, key, timestamp=now_msec(),
+        upload_id, bucket_id, key, timestamp=next_timestamp(existing),
         enc=enc.meta() if enc else None,
     )
     await garage.mpu_table.insert(mpu)

@@ -71,16 +71,33 @@ def _check_sha256(ctx, digest: "hashlib._Hash") -> None:
             )
 
 
-async def check_quotas(garage, bucket_id: bytes, key: str, new_size: int) -> None:
+def next_timestamp(existing: Object | None) -> int:
+    """Version timestamp for a new write: strictly after every version the
+    key already has, even if a clock-skewed node wrote one in the future
+    (reference put.rs:698 next_timestamp — without this, a delete issued
+    after a future-dated write would lose the LWW race and the object
+    would be undeletable until wall clocks catch up)."""
+    ts = now_msec()
+    if existing is not None and existing.versions:
+        ts = max(ts, max(v.timestamp for v in existing.versions) + 1)
+    return ts
+
+
+async def check_quotas(
+    garage, bucket_id: bytes, key: str, new_size: int, existing=None
+) -> None:
     """Enforce bucket quotas against the distributed counters, crediting
-    the object being overwritten (reference put.rs:315 check_quotas)."""
+    the object being overwritten (reference put.rs:315 check_quotas).
+    `existing` skips a second quorum read when the caller already has the
+    object row."""
     bucket = await garage.helper.get_bucket(bucket_id)
     q = bucket.params().quotas.get() or {}
     if not q.get("max_size") and not q.get("max_objects"):
         return
     counts = await garage.object_counter.get_values(bucket_id)
     prev_objects = prev_bytes = 0
-    existing = await garage.object_table.get(bucket_id, key.encode())
+    if existing is None:
+        existing = await garage.object_table.get(bucket_id, key.encode())
     if existing is not None:
         vis = existing.last_visible()
         if vis is not None:
@@ -186,13 +203,15 @@ async def handle_put_object(
     ]
     body = request.content
     block_size = garage.config.block_size
+    existing = await garage.object_table.get(bucket_id, key.encode())
+    ts = next_timestamp(existing)
 
     first = await _read_at_least(body, INLINE_THRESHOLD + 1)
     if len(first) <= INLINE_THRESHOLD:
         # inline object
         sha = hashlib.sha256(first)
         _check_sha256(ctx, sha)
-        await check_quotas(garage, bucket_id, key, len(first))
+        await check_quotas(garage, bucket_id, key, len(first), existing=existing)
         etag = hashlib.md5(first).hexdigest()
         meta = {"size": len(first), "etag": etag, "headers": headers}
         if cks is not None:
@@ -206,7 +225,7 @@ async def handle_put_object(
             meta["enc"] = enc.meta()
         version = ObjectVersion(
             gen_uuid(),
-            now_msec(),
+            ts,
             "complete",
             {"t": "inline", "bytes": stored, "meta": meta},
         )
@@ -218,7 +237,6 @@ async def handle_put_object(
 
     # multi-block object
     vid = gen_uuid()
-    ts = now_msec()
     version0 = ObjectVersion(vid, ts, "uploading", {"t": "first_block", "vid": vid})
     await garage.object_table.insert(Object(bucket_id, key, [version0]))
     await garage.version_table.insert(Version(vid, bucket_id, key))
@@ -232,7 +250,7 @@ async def handle_put_object(
         _check_sha256(ctx, sha)
         if cks is not None and cks.expected_b64 is None:
             cks.resolve_trailer(getattr(body, "trailers", {}) or {})
-        await check_quotas(garage, bucket_id, key, total)
+        await check_quotas(garage, bucket_id, key, total, existing=existing)
 
         etag = md5_hex
         meta = {"size": total, "etag": etag, "headers": headers}
@@ -454,7 +472,12 @@ def _parse_part_number(request) -> int | None:
 
 
 async def handle_get_object(
-    garage, bucket_id: bytes, key: str, request, head_only: bool = False
+    garage,
+    bucket_id: bytes,
+    key: str,
+    request,
+    head_only: bool = False,
+    allow_overrides: bool = True,
 ) -> web.StreamResponse:
     from .encryption import EncryptionParams, check_match
 
@@ -469,18 +492,20 @@ async def handle_get_object(
     if enc_params is not None:
         headers.update(enc_params.response_headers())
 
-    # response-* query overrides (reference get.rs:100-117): the signed
-    # request may rewrite presentation headers
-    for qname, hname in (
-        ("response-cache-control", "Cache-Control"),
-        ("response-content-disposition", "Content-Disposition"),
-        ("response-content-encoding", "Content-Encoding"),
-        ("response-content-language", "Content-Language"),
-        ("response-content-type", "Content-Type"),
-        ("response-expires", "Expires"),
-    ):
-        if qname in request.query:
-            headers[hname] = request.query[qname]
+    # response-* query overrides (reference get.rs:100-117): SIGNED
+    # requests only — on the anonymous website path a visitor-controlled
+    # ?response-content-type would turn uploaded blobs into stored XSS
+    if allow_overrides:
+        for qname, hname in (
+            ("response-cache-control", "Cache-Control"),
+            ("response-content-disposition", "Content-Disposition"),
+            ("response-content-encoding", "Content-Encoding"),
+            ("response-content-language", "Content-Language"),
+            ("response-content-type", "Content-Type"),
+            ("response-expires", "Expires"),
+        ):
+            if qname in request.query:
+                headers[hname] = request.query[qname]
 
     part_number = _parse_part_number(request)
     is_inline = version.data.get("t") == "inline"
@@ -544,6 +569,8 @@ async def handle_delete_object(garage, bucket_id: bytes, key: str) -> web.Respon
     if obj is None or obj.last_visible() is None:
         # deleting a non-existent object is a success in S3
         return web.Response(status=204)
-    dm = ObjectVersion(gen_uuid(), now_msec(), "complete", {"t": "delete_marker"})
+    dm = ObjectVersion(
+        gen_uuid(), next_timestamp(obj), "complete", {"t": "delete_marker"}
+    )
     await garage.object_table.insert(Object(bucket_id, key, [dm]))
     return web.Response(status=204)

@@ -15,6 +15,15 @@ priority level and interleaves chunks round-robin within a level, always
 draining higher-priority levels first: a huge BACKGROUND resync transfer
 adds at most one chunk of latency to a HIGH quorum RPC on the same
 connection — this is the QoS that keeps repair from starving PUT/GET.
+
+Stream flow control is CREDIT-BASED (reference analog: kuska/netapp has
+none; this mirrors HTTP/2 WINDOW_UPDATE): each attached stream starts
+with STREAM_WINDOW bytes of send credit; the receiver grants more
+(CREDIT frames, u32 bytes) as the consuming application actually reads.
+A sender that runs out of credit PARKS its message — it stops occupying
+the scheduler without blocking other messages — and resumes when credit
+arrives, so a slow stream consumer backpressures its producer instead of
+overflowing the receiver's buffer.
 """
 
 from __future__ import annotations
@@ -32,12 +41,16 @@ from .stream import StreamWriter
 logger = logging.getLogger("garage.net")
 
 CHUNK = 16 * 1024
+STREAM_WINDOW = 1024 * 1024  # initial per-stream send credit
+GRANT_BATCH = 256 * 1024  # receiver grants credit in batches this big
 
 K_REQ_META = 1
 K_RESP_META = 2
 K_BODY = 3
 K_STREAM = 4
 K_CANCEL = 5
+K_CREDIT = 6
+K_WAIT = 0  # internal sentinel: generator parked awaiting stream credit
 
 F_FIN = 1
 F_ERR = 2
@@ -54,12 +67,34 @@ class ConnectionClosed(Exception):
 class _Outgoing:
     """One message being sent: frames yielded chunk by chunk."""
 
-    __slots__ = ("frames", "rid", "aborted")
+    __slots__ = ("frames", "rid", "aborted", "owns_credit")
 
-    def __init__(self, frames, rid: int):
+    def __init__(self, frames, rid: int, owns_credit: bool = False):
         self.frames = frames  # async iterator of (kind, flags, id, payload)
         self.rid = rid
         self.aborted = False
+        # True only for the message that registered _out_credit[rid]:
+        # control frames (CREDIT grants, CANCELs) share the rid and must
+        # not tear the credit down when they finish
+        self.owns_credit = owns_credit
+
+
+class _StreamCredit:
+    """Sender-side credit for one attached stream."""
+
+    __slots__ = ("avail", "parked")
+
+    def __init__(self, initial: int = STREAM_WINDOW):
+        self.avail = initial
+        self.parked: tuple[int, _Outgoing] | None = None  # (level, out)
+
+    def grant(self, n: int, conn: "Connection") -> None:
+        self.avail += n
+        if self.parked is not None and self.avail > 0:
+            lvl, out = self.parked
+            self.parked = None
+            conn._send_queues[lvl].put_nowait(out)
+            conn._send_wakeup.set()
 
 
 async def _frames_of(
@@ -68,8 +103,11 @@ async def _frames_of(
     meta: dict,
     body: bytes,
     stream: AsyncIterator[bytes] | None,
+    credit: _StreamCredit | None = None,
 ):
-    """Async generator of frames for one message."""
+    """Async generator of frames for one message.  When stream credit is
+    exhausted it yields a K_WAIT sentinel instead of blocking — the send
+    loop parks the message so other traffic keeps flowing."""
     yield (kind_meta, 0, rid, _pack(meta))
     if body or stream is None:
         n = max(1, (len(body) + CHUNK - 1) // CHUNK)
@@ -84,8 +122,16 @@ async def _frames_of(
         async for chunk in stream:
             pending += chunk
             while len(pending) >= CHUNK:
+                while credit is not None and credit.avail <= 0:
+                    yield (K_WAIT, 0, rid, b"")
+                if credit is not None:
+                    credit.avail -= CHUNK
                 yield (K_STREAM, 0, rid, pending[:CHUNK])
                 pending = pending[CHUNK:]
+        while credit is not None and pending and credit.avail <= 0:
+            yield (K_WAIT, 0, rid, b"")
+        if credit is not None:
+            credit.avail -= len(pending)
         yield (K_STREAM, F_FIN, rid, pending)
 
 
@@ -116,6 +162,8 @@ class Connection:
         self._pending: dict[int, dict] = {}
         # in-flight requests we are receiving: id -> partial state
         self._incoming: dict[int, dict] = {}
+        # send credit for streams we are transmitting, by rid
+        self._out_credit: dict[int, _StreamCredit] = {}
         self._tasks: list[asyncio.Task] = []
         self._closed = False
 
@@ -146,24 +194,48 @@ class Connection:
             "hs": req.stream is not None,
             "ot": req.order_tag.to_obj() if req.order_tag else None,
         }
-        frames = _frames_of(K_REQ_META, rid, meta, _pack(req.body), req.stream)
-        out = await self._enqueue(prio, frames, rid)
+        credit = None
+        if req.stream is not None:
+            credit = self._out_credit[rid] = _StreamCredit()
+        frames = _frames_of(
+            K_REQ_META, rid, meta, _pack(req.body), req.stream, credit
+        )
+        out = await self._enqueue(prio, frames, rid, owns_credit=credit is not None)
         self._pending[rid]["out"] = out
         try:
             if timeout is not None:
                 return await asyncio.wait_for(fut, timeout)
             return await fut
         except (asyncio.TimeoutError, asyncio.CancelledError):
+            self._abort_out(rid)  # stop transmitting remaining chunks
             self._pending.pop(rid, None)
-            out.aborted = True  # stop transmitting remaining chunks
             await self._enqueue(0, _one_frame(K_CANCEL, 0, rid, b""), rid)
             raise
 
     def _rid_is_mine(self, rid: int) -> bool:
         return (rid & 1) == (1 if self.initiator else 0)
 
-    async def _enqueue(self, prio: int, frames, rid: int) -> _Outgoing:
-        out = _Outgoing(frames, rid)
+    def _abort_out(self, rid: int) -> None:
+        """Stop transmitting rid's message (half-close): mark it aborted
+        and, if it is PARKED on stream credit, requeue it so the send loop
+        finalizes it — otherwise a sender parked forever (peer stopped
+        granting) would leak its producer generator and credit entry."""
+        credit = self._out_credit.get(rid)
+        p = self._pending.get(rid)
+        out = p.get("out") if p else None
+        if out is not None:
+            out.aborted = True
+        if credit is not None and credit.parked is not None:
+            lvl, parked_out = credit.parked
+            credit.parked = None
+            parked_out.aborted = True
+            self._send_queues[lvl].put_nowait(parked_out)
+            self._send_wakeup.set()
+
+    async def _enqueue(
+        self, prio: int, frames, rid: int, owns_credit: bool = False
+    ) -> _Outgoing:
+        out = _Outgoing(frames, rid, owns_credit=owns_credit)
         self._send_queues[prio_level(prio)].put_nowait(out)
         self._send_wakeup.set()
         return out
@@ -182,12 +254,22 @@ class Connection:
                     await self._send_wakeup.wait()
                     continue
                 if out.aborted:
-                    continue  # caller gave up: drop remaining chunks
+                    # caller gave up: drop remaining chunks and release the
+                    # producer generator + its credit entry
+                    try:
+                        await out.frames.aclose()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    if out.owns_credit:
+                        self._out_credit.pop(out.rid, None)
+                    continue
                 # send ONE chunk of this message, then rotate it to the back
                 # of its level queue (round-robin within priority)
                 try:
                     frame = await out.frames.__anext__()
                 except StopAsyncIteration:
+                    if out.owns_credit:
+                        self._out_credit.pop(out.rid, None)
                     continue
                 except Exception as e:  # stream producer failed mid-message
                     logger.warning(
@@ -209,6 +291,14 @@ class Connection:
                             await p["writer"].close(f"request aborted: {e}")
                     continue
                 kind, flags, rid, payload = frame
+                if kind == K_WAIT:
+                    # out of stream credit: park; a CREDIT frame requeues it
+                    credit = self._out_credit.get(rid)
+                    if credit is None or credit.avail > 0:
+                        self._send_queues[lvl].put_nowait(out)  # raced a grant
+                    else:
+                        credit.parked = (lvl, out)
+                    continue
                 self.box.send_frame(
                     struct.pack("<BBI", kind, flags, rid) + payload
                 )
@@ -244,7 +334,13 @@ class Connection:
                     await self._on_body(rid, flags, payload)
                 elif kind == K_STREAM:
                     await self._on_stream(rid, flags, payload)
+                elif kind == K_CREDIT:
+                    credit = self._out_credit.get(rid)
+                    if credit is not None:
+                        (n,) = struct.unpack("<I", payload)
+                        credit.grant(n, self)
                 elif kind == K_CANCEL:
+                    self._abort_out(rid)  # stop any stream we send on rid
                     if self._rid_is_mine(rid):
                         # peer aborted its response (e.g. stream producer
                         # failed server-side)
@@ -284,7 +380,7 @@ class Connection:
             st["body"].append(payload)
             if flags & F_FIN:
                 body = _unpack(b"".join(st["body"]))
-                writer = StreamWriter()
+                writer = StreamWriter(on_consume=self._granter(rid))
                 st["writer"] = writer
                 if not st["meta"].get("hs"):
                     await writer.close()  # no attached stream coming
@@ -297,10 +393,15 @@ class Connection:
         p.setdefault("body", []).append(payload)
         if flags & F_FIN:
             body = _unpack(b"".join(p["body"]))
-            writer = StreamWriter()
+            writer = StreamWriter(on_consume=self._granter(rid))
             p["writer"] = writer
             meta = p.get("meta", {})
             fut: asyncio.Future = p["fut"]
+            # half-close: once the peer has answered, any still-unsent tail
+            # of OUR request stream is useless — stop transmitting it
+            # (otherwise a handler that answered early leaves our producer
+            # parked on credit forever)
+            self._abort_out(rid)
             if meta.get("err"):
                 if not fut.done():
                     fut.set_exception(RemoteError(meta["err"]))
@@ -328,6 +429,26 @@ class Connection:
             if self._rid_is_mine(rid):
                 self._pending.pop(rid, None)  # response fully received
 
+    def _granter(self, rid: int):
+        """Batched credit grants for a stream we are receiving: called by
+        the StreamWriter as the application consumes bytes."""
+        acc = 0
+
+        def on_consume(n: int) -> None:
+            nonlocal acc
+            acc += n
+            if acc >= GRANT_BATCH and not self._closed:
+                grant, acc = acc, 0
+                self._send_queues[0].put_nowait(
+                    _Outgoing(
+                        _one_frame(K_CREDIT, 0, rid, struct.pack("<I", grant)),
+                        rid,
+                    )
+                )
+                self._send_wakeup.set()
+
+        return on_consume
+
     async def _run_handler(self, rid: int, st: dict, req: Req) -> None:
         meta = st["meta"]
         try:
@@ -337,8 +458,11 @@ class Connection:
                 "hs": resp.stream is not None,
                 "ot": resp.order_tag.to_obj() if resp.order_tag else meta.get("ot"),
             }
+            credit = None
+            if resp.stream is not None:
+                credit = self._out_credit[rid] = _StreamCredit()
             frames = _frames_of(
-                K_RESP_META, rid, rmeta, _pack(resp.body), resp.stream
+                K_RESP_META, rid, rmeta, _pack(resp.body), resp.stream, credit
             )
         except asyncio.CancelledError:
             self._incoming.pop(rid, None)
@@ -348,7 +472,10 @@ class Connection:
             frames = _frames_of(
                 K_RESP_META, rid, {"err": f"{type(e).__name__}: {e}"}, _pack(None), None
             )
-        await self._enqueue(meta.get("prio", PRIO_NORMAL), frames, rid)
+        await self._enqueue(
+            meta.get("prio", PRIO_NORMAL), frames, rid,
+            owns_credit=rid in self._out_credit,
+        )
         self._incoming.pop(rid, None)
 
     # --- teardown ------------------------------------------------------------
@@ -371,6 +498,7 @@ class Connection:
             if st.get("writer"):
                 await st["writer"].close("connection lost")
         self._incoming.clear()
+        self._out_credit.clear()
         self._send_wakeup.set()
         try:
             self.box.writer.close()

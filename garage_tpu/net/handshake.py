@@ -43,7 +43,7 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import (
 )
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
-VERSION_TAG = b"grg_tpu1"  # protocol version gate (1: role-bound auth sigs)
+VERSION_TAG = b"grg_tpu2"  # protocol version gate (2: stream flow control)
 MAX_FRAME = 20 * 1024
 
 

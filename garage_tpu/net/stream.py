@@ -24,15 +24,17 @@ class StreamWriter:
 
     `feed` never blocks (the connection's single recv loop must keep
     serving other multiplexed requests even if one stream's consumer is
-    slow or absent); instead the buffer is byte-budgeted and the stream is
-    failed with an overflow error if the consumer falls more than
-    `max_buffer` behind.  Credit-based per-stream flow control is the
-    eventual replacement; the budget comfortably covers block-sized
-    transfers."""
+    slow or absent).  The primary backpressure is CREDIT-BASED flow
+    control (connection.py): the peer stops sending once its
+    STREAM_WINDOW of credit runs out, and `on_consume(n)` — called as the
+    application drains bytes — is how the connection grants more.  The
+    `max_buffer` overflow failure remains as a safety net against peers
+    that ignore credit."""
 
-    def __init__(self, max_buffer: int = 16 * 1024 * 1024):
+    def __init__(self, max_buffer: int = 16 * 1024 * 1024, on_consume=None):
         self.q: asyncio.Queue = asyncio.Queue()
         self.max_buffer = max_buffer
+        self.on_consume = on_consume
         self._buffered = 0
         self._closed = False
 
@@ -59,6 +61,8 @@ class StreamWriter:
                 if isinstance(item, StreamError):
                     raise item
                 self._buffered -= len(item)
+                if self.on_consume is not None and item:
+                    self.on_consume(len(item))
                 yield item
 
         return gen()

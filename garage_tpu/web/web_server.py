@@ -81,12 +81,14 @@ class WebServer:
             resp = await handle_get_object(
                 self.garage, bucket_id, key, request,
                 head_only=(request.method == "HEAD"),
+                allow_overrides=False,  # anonymous path: no response-* rewrites
             )
         except ApiError as e:
             if e.status == 404 and website.get("error_document"):
                 try:
                     resp = await handle_get_object(
-                        self.garage, bucket_id, website["error_document"], request
+                        self.garage, bucket_id, website["error_document"],
+                        request, allow_overrides=False,
                     )
                     if not resp.prepared:
                         resp.set_status(404)

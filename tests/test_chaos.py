@@ -202,3 +202,58 @@ def test_layout_change_under_load(tmp_path):
             await stop_cluster(garages, servers, clients)
 
     run(main())
+
+
+def test_clock_skew_nemesis_delete_and_overwrite_win(tmp_path):
+    """A node with a fast clock writes a version dated in the future; a
+    correctly-clocked delete and overwrite issued LATER must still win
+    (next_timestamp allocates strictly past every existing version —
+    without it the object would be undeletable until wall time catches
+    up; reference put.rs:698)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(__file__))
+    from test_s3_api import make_client, make_daemon, teardown
+
+    from garage_tpu.api.s3.client import S3Error
+    from garage_tpu.model.s3.object_table import Object, ObjectVersion
+    from garage_tpu.utils.data import gen_uuid
+    from garage_tpu.utils.time_util import now_msec
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("skew")
+
+            # a skewed node's write: version dated 1 hour in the future
+            future_ts = now_msec() + 3_600_000
+            skewed = ObjectVersion(
+                gen_uuid(), future_ts, "complete",
+                {"t": "inline", "bytes": b"from the future",
+                 "meta": {"size": 15, "etag": "f" * 32, "headers": []}},
+            )
+            bid = await garage.helper.resolve_bucket("skew")
+            await garage.object_table.insert(Object(bid, "doomed", [skewed]))
+            assert await client.get_object("skew", "doomed") == b"from the future"
+
+            # the delete must take effect immediately
+            await client.delete_object("skew", "doomed")
+            import pytest as _pytest
+
+            with _pytest.raises(S3Error):
+                await client.get_object("skew", "doomed")
+
+            # and an overwrite of another future-dated key must be visible
+            skewed2 = ObjectVersion(
+                gen_uuid(), future_ts, "complete",
+                {"t": "inline", "bytes": b"old future",
+                 "meta": {"size": 10, "etag": "e" * 32, "headers": []}},
+            )
+            await garage.object_table.insert(Object(bid, "replaced", [skewed2]))
+            await client.put_object("skew", "replaced", b"new reality")
+            assert await client.get_object("skew", "replaced") == b"new reality"
+        finally:
+            await teardown(garage, s3)
+
+    run(main())

@@ -391,3 +391,65 @@ def test_stream_producer_failure_unblocks_peer():
         await b.shutdown()
 
     run(main())
+
+
+def test_stream_flow_control_backpressure():
+    """A slow stream consumer must backpressure the sender: outstanding
+    bytes stay within the credit window instead of ballooning, other
+    requests on the connection keep flowing, and the transfer completes."""
+
+    async def main():
+        from garage_tpu.net.connection import STREAM_WINDOW
+
+        a, b = await make_node(), await make_node()
+        produced = 0
+        total = 6 * STREAM_WINDOW
+        consumed = asyncio.Event()
+
+        async def producer():
+            nonlocal produced
+            chunk = b"x" * 65536
+            while produced < total:
+                produced += len(chunk)
+                yield chunk
+
+        async def handler(from_id, req):
+            # consume slowly at first, then drain
+            it = req.stream.__aiter__()
+            got = 0
+            first = await it.__anext__()
+            got += len(first)
+            await asyncio.sleep(0.5)  # let the producer run ahead if it can
+            # the producer must be throttled by credit, not unbounded:
+            # it can be at most window + scheduler slack ahead of us
+            assert produced - got <= STREAM_WINDOW + 512 * 1024, (
+                f"producer ran {produced - got} bytes ahead of the consumer"
+            )
+            async for chunk in it:
+                got += len(chunk)
+            consumed.set()
+            return Resp(got)
+
+        async def ping(from_id, req):
+            return Resp("pong")
+
+        b.endpoint("t/fc").set_handler(handler)
+        b.endpoint("t/ping").set_handler(ping)
+        await a.connect(b.bind_addr, b.id)
+
+        call = asyncio.create_task(
+            a.endpoint("t/fc").call(b.id, None, stream=producer(), timeout=60)
+        )
+        # while the big stream is parked on credit, small RPCs still flow
+        await asyncio.sleep(0.2)
+        r = await asyncio.wait_for(
+            a.endpoint("t/ping").call(b.id, None), timeout=5
+        )
+        assert r.body == "pong"
+        resp = await call
+        assert resp.body == total
+        assert consumed.is_set()
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())

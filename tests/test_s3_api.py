@@ -884,6 +884,17 @@ def test_bucket_config_and_website(tmp_path):
                 ) as resp:
                     assert resp.status == 404
                     assert await resp.read() == b"<h1>oops</h1>"
+                # anonymous visitors must NOT rewrite response headers
+                # (?response-content-type on uploads = stored XSS)
+                await client.put_object("site", "blob.bin", b"<script>x</script>",
+                                        "application/octet-stream")
+                async with sess.get(
+                    f"http://127.0.0.1:{web_port}/blob.bin",
+                    params={"response-content-type": "text/html"},
+                    headers={"Host": "site.web.garage"},
+                ) as resp:
+                    assert resp.status == 200
+                    assert resp.headers["Content-Type"] != "text/html"
             # CORS config roundtrip
             ccfg = (
                 b"<CORSConfiguration><CORSRule>"
