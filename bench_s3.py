@@ -49,6 +49,12 @@ async def run_cluster(tmp_path, mode: str, n_objects: int, size: int) -> dict:
     try:
         await client.create_bucket("bench")
         body = os.urandom(size)
+        # warmup: worker spin-up / allocator effects must not pollute p99;
+        # measure steady state by swapping in a fresh registry after it
+        for i in range(10):
+            await client.put_object("bench", f"warm{i}", body)
+        registry = metrics_mod.Metrics()
+        metrics_mod.registry = registry
         for i in range(n_objects):
             await client.put_object("bench", f"o{i:05d}", body)
         for i in range(0, n_objects, 4):

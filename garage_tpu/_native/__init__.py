@@ -20,7 +20,12 @@ import numpy as np
 logger = logging.getLogger("garage.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_DIR, "libgarage_native.so")
+# GARAGE_NATIVE_SO points the loader at an alternative build — the
+# sanitizer harness (script/sanitize-native.sh) uses it to run the same
+# oracle cross-checks against an ASan/UBSan-instrumented library
+_SO = os.environ.get(
+    "GARAGE_NATIVE_SO", os.path.join(_DIR, "libgarage_native.so")
+)
 _SOURCES = ["gf8.cpp", "blake3.cpp"]
 
 _lib: ctypes.CDLL | None = None

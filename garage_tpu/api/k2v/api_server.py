@@ -262,51 +262,124 @@ class K2VApiServer:
         return web.Response(status=204)
 
     async def _read_batch(self, bucket_id, request) -> web.Response:
+        """ReadBatch with the full reference query surface
+        (src/api/k2v/batch.rs ReadBatchQuery): prefix, start, end, limit,
+        reverse, singleItem, conflictsOnly, tombstones."""
         body = json.loads(await request.read())
         out = []
         for search in body:
             pk = search["partitionKey"]
+            prefix = search.get("prefix")
             start = search.get("start")
             end = search.get("end")
             limit = min(int(search.get("limit") or 1000), 1000)
-            items = await self.garage.k2v_item_table.get_range(
-                bucket_id + pk.encode(),
-                start.encode() if start else None,
-                "present",
-                limit + 1,
-            )
+            reverse = bool(search.get("reverse"))
+            single = bool(search.get("singleItem"))
+            conflicts_only = bool(search.get("conflictsOnly"))
+            tombstones = bool(search.get("tombstones"))
+            filt = None if tombstones else "present"
+
+            if single:
+                if start is None:
+                    raise ValueError("singleItem requires start")
+                item = await self.garage.k2v_item_table.get(
+                    bucket_id + pk.encode(), start.encode()
+                )
+
+                async def _single(_item=item):
+                    if _item is not None:
+                        yield _item
+
+                items = _single()
+            else:
+                if reverse and start is None and prefix is not None:
+                    # reverse scan of a prefix range starts just PAST the
+                    # prefix and walks down (the filter skips the first
+                    # non-matching key)
+                    from ...db import _prefix_end
+
+                    begin_bytes = _prefix_end(prefix.encode())
+                else:
+                    begin = start if start is not None else prefix
+                    begin_bytes = begin.encode() if begin else None
+                items = self._iter_partition(
+                    bucket_id + pk.encode(), begin_bytes, filt, reverse
+                )
             rows = []
             more = False
             next_start = None
-            for item in items:
-                if end is not None and item.sort_key >= end:
+            async for item in items:
+                sk = item.sort_key
+                if prefix is not None and not sk.startswith(prefix):
+                    if (not reverse and sk > prefix) or (reverse and sk < prefix):
+                        break
+                    continue
+                if end is not None and (
+                    (not reverse and sk >= end) or (reverse and sk <= end)
+                ):
                     break
+                if not tombstones and item.is_tombstone():
+                    continue
+                if conflicts_only and len(item.live_values()) <= 1:
+                    continue
                 if len(rows) >= limit:
                     more = True
-                    next_start = item.sort_key
+                    next_start = sk
                     break
                 rows.append(
                     {
-                        "sk": item.sort_key,
+                        "sk": sk,
                         "ct": item.causal_context().serialize(),
                         "v": [
-                            base64.b64encode(v).decode()
-                            for v in item.live_values()
+                            base64.b64encode(v).decode() if v is not None else None
+                            for v in (
+                                item.values() if tombstones else item.live_values()
+                            )
                         ],
                     }
                 )
             out.append(
                 {
                     "partitionKey": pk,
+                    "prefix": prefix,
                     "start": start,
                     "end": end,
                     "limit": limit,
+                    "reverse": reverse,
+                    "singleItem": single,
+                    "conflictsOnly": conflicts_only,
+                    "tombstones": tombstones,
                     "items": rows,
                     "more": more,
                     "nextStart": next_start,
                 }
             )
         return web.json_response(out)
+
+    async def _iter_partition(self, part_pk: bytes, begin_bytes, filt, reverse):
+        """Page through a partition's items without a silent row cap —
+        filters like conflictsOnly may discard arbitrarily many rows
+        before filling a page, so enumeration must continue until the
+        partition range is exhausted."""
+        cursor = begin_bytes
+        skip_past: str | None = None  # reverse resume is inclusive: skip it
+        while True:
+            batch = await self.garage.k2v_item_table.get_range(
+                part_pk, cursor, filt, 1000, reverse=reverse
+            )
+            if not batch:
+                return
+            for item in batch:
+                if skip_past is not None and item.sort_key >= skip_past:
+                    continue
+                yield item
+            last = batch[-1].sort_key
+            if len(batch) < 1000:
+                return
+            if reverse:
+                cursor, skip_past = last.encode(), last
+            else:
+                cursor, skip_past = last.encode() + b"\x00", None
 
     async def _delete_batch(self, bucket_id, request) -> web.Response:
         body = json.loads(await request.read())

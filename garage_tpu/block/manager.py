@@ -411,6 +411,14 @@ class BlockManager:
         """Store a block on its replica set (quorum in every active layout
         version).  With an EC codec, each node receives only its piece.
         Payloads ride attached streams; aggregate buffer RAM is budgeted."""
+        from ..utils.metrics import registry
+        from ..utils.tracing import span
+
+        with span("block:put", size=len(data)):
+            await self._rpc_put_block(hash32, data)
+        registry.incr("block_bytes_written", by=len(data))  # successes only
+
+    async def _rpc_put_block(self, hash32: bytes, data: bytes) -> None:
         from ..net.stream import bytes_stream
 
         layout = self.system.layout_manager.history
@@ -478,6 +486,15 @@ class BlockManager:
         """Fetch a block: local first, then peers in latency order with
         fallback (reference manager.rs:243-344).  EC mode gathers k pieces
         (data-piece fast path, any-k + decode on failure)."""
+        from ..utils.metrics import registry
+        from ..utils.tracing import span
+
+        with span("block:get"):
+            data = await self._rpc_get_block(hash32, prio)
+        registry.incr("block_bytes_read", by=len(data))
+        return data
+
+    async def _rpc_get_block(self, hash32: bytes, prio: int = PRIO_NORMAL) -> bytes:
         if self.codec.n_pieces == 1:
             local = await self.read_block_local(hash32)
             if local is not None:

@@ -164,6 +164,10 @@ class Connection:
         self._incoming: dict[int, dict] = {}
         # send credit for streams we are transmitting, by rid
         self._out_credit: dict[int, _StreamCredit] = {}
+        # stream-bearing messages currently circulating in the send
+        # queues, by rid — so a peer CANCEL can abort them mid-flight
+        # (they are reachable neither via _pending nor via credit.parked)
+        self._active_out: dict[int, _Outgoing] = {}
         self._tasks: list[asyncio.Task] = []
         self._closed = False
 
@@ -216,15 +220,20 @@ class Connection:
         return (rid & 1) == (1 if self.initiator else 0)
 
     def _abort_out(self, rid: int) -> None:
-        """Stop transmitting rid's message (half-close): mark it aborted
-        and, if it is PARKED on stream credit, requeue it so the send loop
-        finalizes it — otherwise a sender parked forever (peer stopped
-        granting) would leak its producer generator and credit entry."""
+        """Stop transmitting rid's message (half-close): mark it aborted —
+        whether it is a request we sent (_pending), a response stream
+        mid-transmission (_active_out), or PARKED on stream credit (which
+        needs a requeue so the send loop finalizes it) — otherwise the
+        producer generator and credit entry leak until the connection
+        closes."""
         credit = self._out_credit.get(rid)
         p = self._pending.get(rid)
         out = p.get("out") if p else None
         if out is not None:
             out.aborted = True
+        active = self._active_out.get(rid)
+        if active is not None:
+            active.aborted = True
         if credit is not None and credit.parked is not None:
             lvl, parked_out = credit.parked
             credit.parked = None
@@ -236,6 +245,8 @@ class Connection:
         self, prio: int, frames, rid: int, owns_credit: bool = False
     ) -> _Outgoing:
         out = _Outgoing(frames, rid, owns_credit=owns_credit)
+        if owns_credit:
+            self._active_out[rid] = out
         self._send_queues[prio_level(prio)].put_nowait(out)
         self._send_wakeup.set()
         return out
@@ -262,6 +273,7 @@ class Connection:
                         pass
                     if out.owns_credit:
                         self._out_credit.pop(out.rid, None)
+                        self._active_out.pop(out.rid, None)
                     continue
                 # send ONE chunk of this message, then rotate it to the back
                 # of its level queue (round-robin within priority)
@@ -270,6 +282,7 @@ class Connection:
                 except StopAsyncIteration:
                     if out.owns_credit:
                         self._out_credit.pop(out.rid, None)
+                        self._active_out.pop(out.rid, None)
                     continue
                 except Exception as e:  # stream producer failed mid-message
                     logger.warning(
@@ -499,6 +512,7 @@ class Connection:
                 await st["writer"].close("connection lost")
         self._incoming.clear()
         self._out_credit.clear()
+        self._active_out.clear()
         self._send_wakeup.set()
         try:
             self.box.writer.close()

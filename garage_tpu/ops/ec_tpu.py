@@ -74,10 +74,15 @@ def gf_bitmatmul(bitmat, x):
 
 # --- fused Pallas kernel -----------------------------------------------------
 
-def _pick_tile(s: int) -> int:
-    """Largest lane-tile (multiple of 128) dividing S, capped at 8192."""
-    for ts in (8192, 4096, 2048, 1024, 512, 256, 128):
-        if s % ts == 0:
+def _pick_tile(s: int, cap: int | None = None) -> int:
+    """Largest lane-tile (multiple of 128) dividing S, capped at `cap`
+    (default 8192, overridable via GARAGE_EC_TILE for on-chip tuning:
+    bigger tiles amortize per-grid-step overhead against VMEM budget)."""
+    import os
+
+    cap = cap or int(os.environ.get("GARAGE_EC_TILE", "8192"))
+    for ts in (65536, 32768, 16384, 8192, 4096, 2048, 1024, 512, 256, 128):
+        if ts <= cap and s % ts == 0:
             return ts
     return 0  # S not a multiple of 128: caller must use the einsum path
 

@@ -62,6 +62,12 @@ class Table:
     async def insert_many(self, entries: list) -> None:
         """Quorum write: group by placement hash, write each group to every
         active layout version's node set (reference table.rs:106-139)."""
+        from ..utils.tracing import span
+
+        with span("table:insert", table=self.schema.table_name, n=len(entries)):
+            await self._insert_many(entries)
+
+    async def _insert_many(self, entries: list) -> None:
         by_sets: dict[bytes, tuple[list[list[bytes]], list[bytes]]] = {}
         for e in entries:
             pk = self.schema.entry_partition_key(e)
@@ -91,6 +97,12 @@ class Table:
     # --- reads ----------------------------------------------------------------
 
     async def get(self, pk: bytes, sk: bytes):
+        from ..utils.tracing import span
+
+        with span("table:get", table=self.schema.table_name):
+            return await self._get(pk, sk)
+
+    async def _get(self, pk: bytes, sk: bytes):
         h = self.schema.partition_hash(pk)
         nodes = self.replication.read_nodes(h)
         quorum = self.replication.read_quorum()

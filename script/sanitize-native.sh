@@ -1,0 +1,54 @@
+#!/bin/sh
+# Sanitizer job for the native C++ hot paths (ASan + UBSan), the rebuild's
+# answer to SURVEY §5's race-detection/sanitizer gap: build an
+# instrumented libgarage_native and run the full oracle cross-check suite
+# against it.  Any overflow, OOB access, or UB in gf8.cpp / blake3.cpp
+# fails the run.
+#
+#   ./script/sanitize-native.sh
+set -e
+cd "$(dirname "$0")/.."
+
+SAN_SO=/tmp/libgarage_native_san.so
+g++ -g -O1 -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -fno-omit-frame-pointer -shared -fPIC -std=c++17 \
+    -o "$SAN_SO" garage_tpu/_native/gf8.cpp garage_tpu/_native/blake3.cpp
+
+LIBASAN=$(g++ -print-file-name=libasan.so)
+export GARAGE_NATIVE_SO="$SAN_SO"
+export LD_PRELOAD="$LIBASAN"
+# the interpreter itself isn't ASan-built: leak checking would drown in
+# Python-internal noise; we want memory-error detection in OUR code
+export ASAN_OPTIONS=detect_leaks=0
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS
+
+python - <<'EOF'
+import numpy as np
+
+from garage_tpu import _native
+from garage_tpu.ops import gf
+from garage_tpu.ops.blake3_ref import blake3 as py_blake3
+
+assert _native.available(), "sanitized library failed to load"
+rng = np.random.default_rng(0)
+
+# GF(2^8) codec: many shapes incl. edge sizes, vs the numpy oracle
+for r, q, s in [(1, 1, 1), (3, 8, 7), (4, 16, 4096), (3, 8, 65536), (8, 8, 1)]:
+    mat = rng.integers(0, 256, (r, q), dtype=np.uint8)
+    shards = rng.integers(0, 256, (q, s), dtype=np.uint8)
+    got = _native.gf8_apply(mat, shards)
+    assert np.array_equal(got, gf.apply_matrix_ref(mat, shards)), (r, q, s)
+
+# BLAKE3: every chunk/block boundary, vs the pure-Python oracle
+for n in [0, 1, 63, 64, 65, 1023, 1024, 1025, 2048, 4096, 16384, 100000]:
+    d = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+    assert _native.blake3(d) == py_blake3(d), n
+
+batch = rng.integers(0, 256, (17, 3072), dtype=np.uint8)
+got = _native.blake3_batch(batch)
+for i in range(17):
+    assert bytes(got[i]) == py_blake3(bytes(batch[i])), i
+
+print("sanitized native library: all oracle checks passed (ASan+UBSan clean)")
+EOF

@@ -427,3 +427,103 @@ def test_k2v_cli(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_k2v_read_batch_full_query_surface(tmp_path):
+    """ReadBatch prefix/reverse/singleItem/conflictsOnly/tombstones
+    (reference src/api/k2v/batch.rs ReadBatchQuery)."""
+
+    async def main():
+        garage, s3, k2v, client = await k2v_daemon(tmp_path)
+        try:
+            await client.insert_batch(
+                [
+                    ("p", "a1", b"v-a1", None),
+                    ("p", "a2", b"v-a2", None),
+                    ("p", "b1", b"v-b1", None),
+                    ("p", "b2", b"v-b2", None),
+                ]
+            )
+            # a conflict on a2: two concurrent (token-less) writes
+            await client.insert_item("p", "a2", b"v-a2-bis")
+            # a tombstone at b1
+            _vals, tok = await client.read_item("p", "b1")
+            await client.delete_item("p", "b1", tok)
+
+            async def rb(**q):
+                return (await client.read_batch([{"partitionKey": "p", **q}]))[0]
+
+            # prefix
+            res = await rb(prefix="a")
+            assert [i["sk"] for i in res["items"]] == ["a1", "a2"]
+            # reverse (whole partition, tombstone excluded)
+            res = await rb(reverse=True)
+            assert [i["sk"] for i in res["items"]] == ["b2", "a2", "a1"]
+            # reverse within a prefix
+            res = await rb(prefix="a", reverse=True)
+            assert [i["sk"] for i in res["items"]] == ["a2", "a1"]
+            # singleItem
+            res = await rb(start="a1", singleItem=True)
+            assert [i["sk"] for i in res["items"]] == ["a1"]
+            # conflictsOnly: only a2 has 2 live values
+            res = await rb(conflictsOnly=True)
+            assert [i["sk"] for i in res["items"]] == ["a2"]
+            assert len(res["items"][0]["v"]) == 2
+            # tombstones: b1 appears with a null value
+            res = await rb(tombstones=True)
+            sks = [i["sk"] for i in res["items"]]
+            assert "b1" in sks
+            b1 = next(i for i in res["items"] if i["sk"] == "b1")
+            assert None in b1["v"]
+        finally:
+            await client.close()
+            await k2v.stop()
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_k2v_conflicts_only_beyond_first_page(tmp_path):
+    """conflictsOnly must page past 1000 non-conflicting rows to find a
+    conflict deeper in the partition (no silent row cap)."""
+
+    async def main():
+        garage, s3, k2v, client = await k2v_daemon(tmp_path)
+        try:
+            from garage_tpu.model.k2v.item_table import K2VItem
+            from garage_tpu.utils.serde import pack
+            from garage_tpu.utils.time_util import now_msec
+
+            bid = await garage.helper.resolve_bucket("k2vtest")
+            table = garage.k2v_item_table
+            base = now_msec()
+            for i in range(1200):
+                item = K2VItem(bid, "big", f"k{i:05d}")
+                item.update(garage.node_id, None, b"v", base + i)
+                if i == 1100:  # plant ONE conflict deep in the partition
+                    item.update(bytes([7]) * 32, None, b"other")
+                table.data.update_entry(pack(table.schema.encode_entry(item)))
+
+            res = (
+                await client.read_batch(
+                    [{"partitionKey": "big", "conflictsOnly": True}]
+                )
+            )[0]
+            assert [i["sk"] for i in res["items"]] == ["k01100"]
+            # and plain pagination still works across the page boundary
+            res1 = (
+                await client.read_batch([{"partitionKey": "big", "limit": 999}])
+            )[0]
+            assert res1["more"] and res1["nextStart"] == "k00999"
+            res2 = (
+                await client.read_batch(
+                    [{"partitionKey": "big", "start": res1["nextStart"]}]
+                )
+            )[0]
+            assert len(res1["items"]) + len(res2["items"]) == 1200
+        finally:
+            await client.close()
+            await k2v.stop()
+            await teardown(garage, s3)
+
+    run(main())
