@@ -20,31 +20,60 @@ import numpy as np
 logger = logging.getLogger("garage.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-# GARAGE_NATIVE_SO points the loader at an alternative build — the
-# sanitizer harness (script/sanitize-native.sh) uses it to run the same
-# oracle cross-checks against an ASan/UBSan-instrumented library
-_SO = os.environ.get(
-    "GARAGE_NATIVE_SO", os.path.join(_DIR, "libgarage_native.so")
-)
+_DEFAULT_SO = os.path.join(_DIR, "libgarage_native.so")
 _SOURCES = ["gf8.cpp", "blake3.cpp"]
 
 _lib: ctypes.CDLL | None = None
 _tried = False
 
 
+def _host_tag() -> str:
+    """Fingerprint of the build host's ISA: -march=native binaries are
+    host-specific, so a cached .so from another machine must be rebuilt
+    (loading it could SIGILL on the first AVX instruction)."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(
+        (platform.machine() + flags).encode()
+    ).hexdigest()[:16]
+
+
 def build(force: bool = False) -> str | None:
-    """Compile the extension; returns the .so path or None on failure."""
+    """Compile the extension into the package-default path; returns the
+    .so path or None on failure.  Never touches a GARAGE_NATIVE_SO
+    override — that env var points at an externally-built (e.g.
+    sanitizer-instrumented) library which must not be overwritten with an
+    uninstrumented one."""
     srcs = [os.path.join(_DIR, s) for s in _SOURCES]
-    if not force and os.path.exists(_SO):
+    tag_file = _DEFAULT_SO + ".host"
+    if not force and os.path.exists(_DEFAULT_SO):
         newest = max(os.path.getmtime(s) for s in srcs)
-        if os.path.getmtime(_SO) >= newest:
-            return _SO
+        try:
+            with open(tag_file) as f:
+                tag_ok = f.read().strip() == _host_tag()
+        except OSError:
+            tag_ok = False
+        if os.path.getmtime(_DEFAULT_SO) >= newest and tag_ok:
+            return _DEFAULT_SO
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", _SO, *srcs,
+        "g++", "-O3", "-march=native", "-pthread", "-shared", "-fPIC",
+        "-std=c++17", "-o", _DEFAULT_SO, *srcs,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return _SO
+        with open(tag_file, "w") as f:
+            f.write(_host_tag())
+        return _DEFAULT_SO
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
         err = getattr(e, "stderr", b"")
         logger.warning("native build failed (%r): %s", e, err.decode(errors="replace")[:500] if err else "")
@@ -52,13 +81,15 @@ def build(force: bool = False) -> str | None:
 
 
 def lib() -> ctypes.CDLL | None:
-    """The loaded library, building it on first use; None if unavailable."""
+    """The loaded library, building it on first use; None if unavailable.
+    GARAGE_NATIVE_SO loads an external build as-is (no rebuild)."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    so = build()
-    if so is None:
+    override = os.environ.get("GARAGE_NATIVE_SO")
+    so = override if override else build()
+    if so is None or not os.path.exists(so):
         return None
     try:
         l = ctypes.CDLL(so)

@@ -9,17 +9,29 @@
 //   gf8_mul_table()                      -> const uint8_t* (256*256)
 //   gf8_apply(mat, r, q, shards, out, s) out[i] = sum_j mat[i,j]*shards[j]
 //
-// The inner loop processes 8 bytes at a time through a per-coefficient
-// 256-byte lookup row; with -O3 g++ vectorizes the gather-free XOR chain.
+// Fast path: split-nibble multiplication (y = LO[c][x & 15] ^ HI[c][x>>4])
+// — 16-entry tables fit a pshufb/vpshufb register, so SSSE3/AVX2 multiply
+// 16/32 bytes per instruction (the ISA-L technique).  Wide shards also
+// split across threads.  Scalar 256-byte-LUT fallback for other ISAs.
 
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include <thread>
+#include <vector>
+
+#if defined(__SSSE3__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
 struct Tables {
     uint8_t mul[256][256];
+    // split-nibble tables: mul[c][x] == lo[c][x & 15] ^ hi[c][x >> 4]
+    // (GF multiply is linear over the XOR decomposition x = lo ^ (hi<<4))
+    alignas(32) uint8_t lo[256][16];
+    alignas(32) uint8_t hi[256][16];
     Tables() {
         uint8_t exp_[512];
         int log_[256] = {0};
@@ -35,6 +47,10 @@ struct Tables {
             for (int b = 0; b < 256; b++) {
                 mul[a][b] = (a && b) ? exp_[log_[a] + log_[b]] : 0;
             }
+            for (int n = 0; n < 16; n++) {
+                lo[a][n] = mul[a][n];
+                hi[a][n] = mul[a][n << 4];
+            }
         }
     }
 };
@@ -42,6 +58,70 @@ struct Tables {
 const Tables& tables() {
     static Tables t;
     return t;
+}
+
+// multiply-accumulate one coefficient over the byte range [b0, b1)
+void mac_range(const Tables& t, uint8_t c, const uint8_t* src, uint8_t* dst,
+               size_t b0, size_t b1) {
+    if (c == 1) {
+        size_t b = b0;
+#if defined(__AVX2__)
+        for (; b + 32 <= b1; b += 32) {
+            __m256i d = _mm256_loadu_si256((const __m256i*)(dst + b));
+            __m256i x = _mm256_loadu_si256((const __m256i*)(src + b));
+            _mm256_storeu_si256((__m256i*)(dst + b), _mm256_xor_si256(d, x));
+        }
+#endif
+        for (; b < b1; b++) dst[b] ^= src[b];
+        return;
+    }
+    size_t b = b0;
+#if defined(__AVX2__)
+    const __m256i vlo = _mm256_broadcastsi128_si256(
+        _mm_load_si128((const __m128i*)t.lo[c]));
+    const __m256i vhi = _mm256_broadcastsi128_si256(
+        _mm_load_si128((const __m128i*)t.hi[c]));
+    const __m256i mask = _mm256_set1_epi8(0x0f);
+    for (; b + 32 <= b1; b += 32) {
+        __m256i x = _mm256_loadu_si256((const __m256i*)(src + b));
+        __m256i l = _mm256_shuffle_epi8(vlo, _mm256_and_si256(x, mask));
+        __m256i h = _mm256_shuffle_epi8(
+            vhi, _mm256_and_si256(_mm256_srli_epi64(x, 4), mask));
+        __m256i d = _mm256_loadu_si256((const __m256i*)(dst + b));
+        _mm256_storeu_si256(
+            (__m256i*)(dst + b),
+            _mm256_xor_si256(d, _mm256_xor_si256(l, h)));
+    }
+#elif defined(__SSSE3__)
+    const __m128i vlo = _mm_load_si128((const __m128i*)t.lo[c]);
+    const __m128i vhi = _mm_load_si128((const __m128i*)t.hi[c]);
+    const __m128i mask = _mm_set1_epi8(0x0f);
+    for (; b + 16 <= b1; b += 16) {
+        __m128i x = _mm_loadu_si128((const __m128i*)(src + b));
+        __m128i l = _mm_shuffle_epi8(vlo, _mm_and_si128(x, mask));
+        __m128i h = _mm_shuffle_epi8(
+            vhi, _mm_and_si128(_mm_srli_epi64(x, 4), mask));
+        __m128i d = _mm_loadu_si128((const __m128i*)(dst + b));
+        _mm_storeu_si128((__m128i*)(dst + b),
+                         _mm_xor_si128(d, _mm_xor_si128(l, h)));
+    }
+#endif
+    const uint8_t* row = t.mul[c];
+    for (; b < b1; b++) dst[b] ^= row[src[b]];
+}
+
+void apply_range(const Tables& t, const uint8_t* mat, int r, int q,
+                 const uint8_t* shards, uint8_t* out, size_t s,
+                 size_t b0, size_t b1) {
+    for (int i = 0; i < r; i++) {
+        uint8_t* dst = out + (size_t)i * s;
+        memset(dst + b0, 0, b1 - b0);
+        for (int j = 0; j < q; j++) {
+            uint8_t c = mat[(size_t)i * q + j];
+            if (c == 0) continue;
+            mac_range(t, c, shards + (size_t)j * s, dst, b0, b1);
+        }
+    }
 }
 
 }  // namespace
@@ -54,32 +134,30 @@ const uint8_t* gf8_mul_table() { return &tables().mul[0][0]; }
 void gf8_apply(const uint8_t* mat, int r, int q,
                const uint8_t* shards, uint8_t* out, size_t s) {
     const Tables& t = tables();
-    memset(out, 0, (size_t)r * s);
-    for (int i = 0; i < r; i++) {
-        uint8_t* dst = out + (size_t)i * s;
-        for (int j = 0; j < q; j++) {
-            uint8_t c = mat[(size_t)i * q + j];
-            if (c == 0) continue;
-            const uint8_t* row = t.mul[c];
-            const uint8_t* src = shards + (size_t)j * s;
-            if (c == 1) {
-                for (size_t b = 0; b < s; b++) dst[b] ^= src[b];
-            } else {
-                size_t b = 0;
-                for (; b + 8 <= s; b += 8) {
-                    dst[b]     ^= row[src[b]];
-                    dst[b + 1] ^= row[src[b + 1]];
-                    dst[b + 2] ^= row[src[b + 2]];
-                    dst[b + 3] ^= row[src[b + 3]];
-                    dst[b + 4] ^= row[src[b + 4]];
-                    dst[b + 5] ^= row[src[b + 5]];
-                    dst[b + 6] ^= row[src[b + 6]];
-                    dst[b + 7] ^= row[src[b + 7]];
-                }
-                for (; b < s; b++) dst[b] ^= row[src[b]];
-            }
-        }
+    // wide shards split by column range across threads (each range is an
+    // independent slice of every row — no sharing, no false sharing at
+    // 64KiB granularity)
+    const size_t kMinPerThread = 1 << 16;
+    unsigned hw = std::thread::hardware_concurrency();
+    size_t nthreads = hw ? hw : 1;
+    if (nthreads > 8) nthreads = 8;
+    if (nthreads > 1 && s / nthreads < kMinPerThread)
+        nthreads = s / kMinPerThread ? s / kMinPerThread : 1;
+    if (nthreads <= 1) {
+        apply_range(t, mat, r, q, shards, out, s, 0, s);
+        return;
     }
+    std::vector<std::thread> workers;
+    size_t step = (s + nthreads - 1) / nthreads;
+    for (size_t k = 0; k < nthreads; k++) {
+        size_t b0 = k * step;
+        size_t b1 = b0 + step < s ? b0 + step : s;
+        if (b0 >= b1) break;
+        workers.emplace_back([&, b0, b1] {
+            apply_range(t, mat, r, q, shards, out, s, b0, b1);
+        });
+    }
+    for (auto& w : workers) w.join();
 }
 
 }  // extern "C"

@@ -302,6 +302,11 @@ class Connection:
                             fut.set_exception(e)
                         if p.get("writer"):
                             await p["writer"].close(f"request aborted: {e}")
+                    # the message is dead: release its credit bookkeeping
+                    # like the aborted/exhausted branches do
+                    if out.owns_credit:
+                        self._out_credit.pop(out.rid, None)
+                        self._active_out.pop(out.rid, None)
                     continue
                 kind, flags, rid, payload = frame
                 if kind == K_WAIT:

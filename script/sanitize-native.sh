@@ -10,9 +10,11 @@ set -e
 cd "$(dirname "$0")/.."
 
 SAN_SO=/tmp/libgarage_native_san.so
-g++ -g -O1 -fsanitize=address,undefined -fno-sanitize-recover=all \
-    -fno-omit-frame-pointer -shared -fPIC -std=c++17 \
-    -o "$SAN_SO" garage_tpu/_native/gf8.cpp garage_tpu/_native/blake3.cpp
+# -march=native so the SIMD (pshufb) paths are the ones instrumented
+g++ -g -O1 -march=native -pthread -fsanitize=address,undefined \
+    -fno-sanitize-recover=all -fno-omit-frame-pointer -shared -fPIC \
+    -std=c++17 -o "$SAN_SO" \
+    garage_tpu/_native/gf8.cpp garage_tpu/_native/blake3.cpp
 
 LIBASAN=$(g++ -print-file-name=libasan.so)
 export GARAGE_NATIVE_SO="$SAN_SO"
