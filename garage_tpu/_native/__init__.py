@@ -34,15 +34,17 @@ def _host_tag() -> str:
     import hashlib
     import platform
 
-    flags = ""
     try:
         with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith("flags"):
-                    flags = line
-                    break
+            flags = next(
+                (line for line in f if line.startswith("flags")), None
+            )
     except OSError:
-        pass
+        flags = None
+    if flags is None:
+        # no ISA fingerprint available: never trust a cached native build
+        # (an arch-only tag would alias hosts with different extensions)
+        return "unknown-host"
     return hashlib.sha256(
         (platform.machine() + flags).encode()
     ).hexdigest()[:16]
@@ -60,7 +62,8 @@ def build(force: bool = False) -> str | None:
         newest = max(os.path.getmtime(s) for s in srcs)
         try:
             with open(tag_file) as f:
-                tag_ok = f.read().strip() == _host_tag()
+                tag = f.read().strip()
+            tag_ok = tag == _host_tag() and tag != "unknown-host"
         except OSError:
             tag_ok = False
         if os.path.getmtime(_DEFAULT_SO) >= newest and tag_ok:

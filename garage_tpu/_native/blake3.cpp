@@ -11,6 +11,11 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
+#include "parallel.h"
+
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -106,6 +111,88 @@ ChunkOut chunk_state(const uint8_t* p, size_t len, uint64_t counter) {
     return out;
 }
 
+#if defined(__AVX2__)
+// --- 8-way chunk hashing: one AVX2 lane per chunk ---------------------------
+// Chunks are independent until the parent fold, and every FULL chunk runs
+// the identical 16-block schedule — so 8 of them execute in lockstep with
+// the 32-bit state held as one __m256i per state word.
+
+inline __m256i rotr_v(__m256i x, int n) {
+    return _mm256_or_si256(_mm256_srli_epi32(x, n), _mm256_slli_epi32(x, 32 - n));
+}
+
+inline void g_v(__m256i* st, int a, int b, int c, int d, __m256i mx, __m256i my) {
+    st[a] = _mm256_add_epi32(_mm256_add_epi32(st[a], st[b]), mx);
+    st[d] = rotr_v(_mm256_xor_si256(st[d], st[a]), 16);
+    st[c] = _mm256_add_epi32(st[c], st[d]);
+    st[b] = rotr_v(_mm256_xor_si256(st[b], st[c]), 12);
+    st[a] = _mm256_add_epi32(_mm256_add_epi32(st[a], st[b]), my);
+    st[d] = rotr_v(_mm256_xor_si256(st[d], st[a]), 8);
+    st[c] = _mm256_add_epi32(st[c], st[d]);
+    st[b] = rotr_v(_mm256_xor_si256(st[b], st[c]), 7);
+}
+
+// hash 8 consecutive FULL chunks at p (stride CHUNK_LEN), chunk counters
+// counter0..counter0+7; writes 8 CVs chunk-major into out_cvs (8*8 words)
+void chunks8(const uint8_t* p, uint64_t counter0, uint32_t* out_cvs) {
+    const __m256i byte_off = _mm256_setr_epi32(
+        0, 1 * CHUNK_LEN, 2 * CHUNK_LEN, 3 * CHUNK_LEN,
+        4 * CHUNK_LEN, 5 * CHUNK_LEN, 6 * CHUNK_LEN, 7 * CHUNK_LEN);
+    __m256i cv[8];
+    for (int w = 0; w < 8; w++) cv[w] = _mm256_set1_epi32((int)IV[w]);
+    alignas(32) uint32_t clo[8], chi[8];
+    for (int l = 0; l < 8; l++) {
+        uint64_t c = counter0 + (uint64_t)l;
+        clo[l] = (uint32_t)c;
+        chi[l] = (uint32_t)(c >> 32);
+    }
+    const __m256i vclo = _mm256_load_si256((const __m256i*)clo);
+    const __m256i vchi = _mm256_load_si256((const __m256i*)chi);
+    const int blocks_per_chunk = (int)(CHUNK_LEN / BLOCK_LEN);
+    for (int b = 0; b < blocks_per_chunk; b++) {
+        __m256i m[16];
+        const uint8_t* base = p + (size_t)b * BLOCK_LEN;
+        for (int w = 0; w < 16; w++) {
+            m[w] = _mm256_i32gather_epi32(
+                (const int*)(base + 4 * w), byte_off, 1);
+        }
+        uint32_t flags = (b == 0 ? CHUNK_START : 0) |
+                         (b == blocks_per_chunk - 1 ? CHUNK_END : 0);
+        __m256i st[16];
+        for (int w = 0; w < 8; w++) st[w] = cv[w];
+        for (int w = 0; w < 4; w++) st[8 + w] = _mm256_set1_epi32((int)IV[w]);
+        st[12] = vclo;
+        st[13] = vchi;
+        st[14] = _mm256_set1_epi32((int)BLOCK_LEN);
+        st[15] = _mm256_set1_epi32((int)flags);
+        for (int r = 0; r < 7; r++) {
+            g_v(st, 0, 4, 8, 12, m[0], m[1]);
+            g_v(st, 1, 5, 9, 13, m[2], m[3]);
+            g_v(st, 2, 6, 10, 14, m[4], m[5]);
+            g_v(st, 3, 7, 11, 15, m[6], m[7]);
+            g_v(st, 0, 5, 10, 15, m[8], m[9]);
+            g_v(st, 1, 6, 11, 12, m[10], m[11]);
+            g_v(st, 2, 7, 8, 13, m[12], m[13]);
+            g_v(st, 3, 4, 9, 14, m[14], m[15]);
+            if (r < 6) {
+                __m256i pmt[16];
+                for (int i = 0; i < 16; i++) pmt[i] = m[MSG_PERM[i]];
+                memcpy(m, pmt, sizeof(m));
+            }
+        }
+        for (int w = 0; w < 8; w++)
+            cv[w] = _mm256_xor_si256(st[w], st[w + 8]);
+    }
+    // transpose lanes out: out_cvs[l*8 + w] = lane l of cv[w]
+    alignas(32) uint32_t tmp[8][8];
+    for (int w = 0; w < 8; w++)
+        _mm256_store_si256((__m256i*)tmp[w], cv[w]);
+    for (int l = 0; l < 8; l++)
+        for (int w = 0; w < 8; w++)
+            out_cvs[l * 8 + w] = tmp[w][l];
+}
+#endif  // __AVX2__
+
 void merge_tree(const uint32_t* cvs, size_t n, uint32_t out_pair[16]);
 
 // reduce a group of chunk CVs to a single CV (non-root parent)
@@ -145,7 +232,14 @@ void blake3_hash(const uint8_t* in, size_t len, uint8_t out[32]) {
         compress(c.cv, c.last_block, 0, c.last_len, c.flags | ROOT, root);
     } else {
         uint32_t* cvs = new uint32_t[n_chunks * 8];
-        for (size_t i = 0; i < n_chunks; i++) {
+        size_t i = 0;
+#if defined(__AVX2__)
+        // full chunks run 8 at a time, one AVX2 lane each
+        size_t n_full = len / CHUNK_LEN;
+        for (; i + 8 <= n_full; i += 8)
+            chunks8(in + i * CHUNK_LEN, (uint64_t)i, cvs + i * 8);
+#endif
+        for (; i < n_chunks; i++) {
             size_t off = i * CHUNK_LEN;
             size_t clen = (off + CHUNK_LEN <= len) ? CHUNK_LEN : len - off;
             ChunkOut c = chunk_state(in + off, clen, (uint64_t)i);
@@ -167,9 +261,14 @@ void blake3_hash(const uint8_t* in, size_t len, uint8_t out[32]) {
 }
 
 void blake3_batch(const uint8_t* in, size_t n, size_t each_len, uint8_t* out) {
-    for (size_t i = 0; i < n; i++) {
-        blake3_hash(in + i * each_len, each_len, out + i * 32);
-    }
+    // items are independent: split the batch across threads when there is
+    // enough work to amortize spawn cost (~scrub batches are MBs)
+    garage_native::parallel_ranges(
+        n, each_len, (size_t)1 << 18,
+        [=](size_t i0, size_t i1) {
+            for (size_t i = i0; i < i1; i++)
+                blake3_hash(in + i * each_len, each_len, out + i * 32);
+        });
 }
 
 }  // extern "C"

@@ -17,8 +17,7 @@
 #include <cstdint>
 #include <cstddef>
 #include <cstring>
-#include <thread>
-#include <vector>
+#include "parallel.h"
 
 #if defined(__SSSE3__)
 #include <immintrin.h>
@@ -135,29 +134,13 @@ void gf8_apply(const uint8_t* mat, int r, int q,
                const uint8_t* shards, uint8_t* out, size_t s) {
     const Tables& t = tables();
     // wide shards split by column range across threads (each range is an
-    // independent slice of every row — no sharing, no false sharing at
-    // 64KiB granularity)
-    const size_t kMinPerThread = 1 << 16;
-    unsigned hw = std::thread::hardware_concurrency();
-    size_t nthreads = hw ? hw : 1;
-    if (nthreads > 8) nthreads = 8;
-    if (nthreads > 1 && s / nthreads < kMinPerThread)
-        nthreads = s / kMinPerThread ? s / kMinPerThread : 1;
-    if (nthreads <= 1) {
-        apply_range(t, mat, r, q, shards, out, s, 0, s);
-        return;
-    }
-    std::vector<std::thread> workers;
-    size_t step = (s + nthreads - 1) / nthreads;
-    for (size_t k = 0; k < nthreads; k++) {
-        size_t b0 = k * step;
-        size_t b1 = b0 + step < s ? b0 + step : s;
-        if (b0 >= b1) break;
-        workers.emplace_back([&, b0, b1] {
+    // independent slice of every row); per-column work scales with r*q,
+    // so the serial threshold does too
+    garage_native::parallel_ranges(
+        s, (size_t)r * (size_t)q, (size_t)1 << 19,
+        [&](size_t b0, size_t b1) {
             apply_range(t, mat, r, q, shards, out, s, b0, b1);
         });
-    }
-    for (auto& w : workers) w.join();
 }
 
 }  // extern "C"
