@@ -27,10 +27,12 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _host_tag() -> str:
+def _host_tag() -> str | None:
     """Fingerprint of the build host's ISA: -march=native binaries are
     host-specific, so a cached .so from another machine must be rebuilt
-    (loading it could SIGILL on the first AVX instruction)."""
+    (loading it could SIGILL on the first AVX instruction).  None when the
+    host exposes no fingerprint — the build then drops -march=native and
+    produces a portable (cacheable everywhere) binary instead."""
     import hashlib
     import platform
 
@@ -42,9 +44,7 @@ def _host_tag() -> str:
     except OSError:
         flags = None
     if flags is None:
-        # no ISA fingerprint available: never trust a cached native build
-        # (an arch-only tag would alias hosts with different extensions)
-        return "unknown-host"
+        return None
     return hashlib.sha256(
         (platform.machine() + flags).encode()
     ).hexdigest()[:16]
@@ -58,24 +58,28 @@ def build(force: bool = False) -> str | None:
     uninstrumented one."""
     srcs = [os.path.join(_DIR, s) for s in _SOURCES]
     tag_file = _DEFAULT_SO + ".host"
+    host = _host_tag()
+    # no ISA fingerprint -> portable build: cacheable on any host of this
+    # arch, at the cost of the SIMD fast paths
+    want_tag = host if host is not None else "portable"
     if not force and os.path.exists(_DEFAULT_SO):
         newest = max(os.path.getmtime(s) for s in srcs)
         try:
             with open(tag_file) as f:
-                tag = f.read().strip()
-            tag_ok = tag == _host_tag() and tag != "unknown-host"
+                tag_ok = f.read().strip() == want_tag
         except OSError:
             tag_ok = False
         if os.path.getmtime(_DEFAULT_SO) >= newest and tag_ok:
             return _DEFAULT_SO
+    march = ["-march=native"] if host is not None else []
     cmd = [
-        "g++", "-O3", "-march=native", "-pthread", "-shared", "-fPIC",
+        "g++", "-O3", *march, "-pthread", "-shared", "-fPIC",
         "-std=c++17", "-o", _DEFAULT_SO, *srcs,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         with open(tag_file, "w") as f:
-            f.write(_host_tag())
+            f.write(want_tag)
         return _DEFAULT_SO
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
         err = getattr(e, "stderr", b"")

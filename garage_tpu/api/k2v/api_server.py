@@ -210,21 +210,33 @@ class K2VApiServer:
         prefix = q.get("prefix", "")
         limit = min(int(q.get("limit", "1000")), 1000)
         start = q.get("start", "")
-        # partition keys live in the counter table, keyed (bucket, pk):
-        # an ordered distributed range read (reference index.rs)
-        begin = max(start, prefix).encode() if (start or prefix) else None
-        ents = await self.garage.k2v_counter_table.get_range(
-            bucket_id, begin, None, limit + 1
-        )
+        # full ReadIndexQuery surface (reference index.rs): prefix, start,
+        # end, limit, reverse.  Partition keys live in the counter table,
+        # keyed (bucket, pk): an ordered distributed range read, streamed
+        # so filtered-out rows never eat the page budget.
+        end = q.get("end")
+        reverse = q.get("reverse") == "true"
+        begin = self._range_begin(prefix or None, start or None, reverse)
         nodes = self.garage.system.layout_manager.history.current().storage_nodes()
         seen = []
-        for ent in ents:
+        async for ent in self._iter_range(
+            self.garage.k2v_counter_table, bucket_id, begin, None, reverse,
+            lambda e: e.sk.decode(errors="replace"),
+        ):
             pk = ent.sk.decode(errors="replace")
             if prefix and not pk.startswith(prefix):
-                break  # sorted: past the prefix range
+                if (not reverse and pk > prefix) or (reverse and pk < prefix):
+                    break  # sorted: past the prefix range
+                continue
+            if end is not None and (
+                (not reverse and pk >= end) or (reverse and pk <= end)
+            ):
+                break
             vals = ent.aggregate(nodes)
             if vals.get("items", 0) <= 0:
                 continue
+            if len(seen) > limit:
+                break
             seen.append((pk, vals))
         truncated = len(seen) > limit
         seen = seen[:limit]
@@ -292,18 +304,11 @@ class K2VApiServer:
 
                 items = _single()
             else:
-                if reverse and start is None and prefix is not None:
-                    # reverse scan of a prefix range starts just PAST the
-                    # prefix and walks down (the filter skips the first
-                    # non-matching key)
-                    from ...db import _prefix_end
-
-                    begin_bytes = _prefix_end(prefix.encode())
-                else:
-                    begin = start if start is not None else prefix
-                    begin_bytes = begin.encode() if begin else None
                 items = self._iter_partition(
-                    bucket_id + pk.encode(), begin_bytes, filt, reverse
+                    bucket_id + pk.encode(),
+                    self._range_begin(prefix, start, reverse),
+                    filt,
+                    reverse,
                 )
             rows = []
             more = False
@@ -356,30 +361,54 @@ class K2VApiServer:
             )
         return web.json_response(out)
 
-    async def _iter_partition(self, part_pk: bytes, begin_bytes, filt, reverse):
-        """Page through a partition's items without a silent row cap —
-        filters like conflictsOnly may discard arbitrarily many rows
-        before filling a page, so enumeration must continue until the
-        partition range is exhausted."""
+    @staticmethod
+    def _range_begin(prefix: str | None, start: str | None, reverse: bool):
+        """Start bound for a (possibly reverse) range enumeration, shared
+        by ReadBatch and ReadIndex.  Reverse scans start AT the bound and
+        walk DOWN, so `start` is an upper bound there; with only a prefix
+        the reverse scan starts just past the prefix range."""
+        if reverse:
+            if start is not None:
+                return start.encode()
+            if prefix is not None:
+                from ...db import _prefix_end
+
+                return _prefix_end(prefix.encode())
+            return None
+        begin = start if start is not None else prefix
+        return begin.encode() if begin else None
+
+    async def _iter_range(self, table, part_pk: bytes, begin_bytes, filt,
+                          reverse, sk_of):
+        """Page through a partition range without a silent row cap —
+        filters may discard arbitrarily many rows before filling a page,
+        so enumeration must continue until the range is exhausted.
+        `sk_of(entry) -> str` extracts the sort key."""
         cursor = begin_bytes
         skip_past: str | None = None  # reverse resume is inclusive: skip it
         while True:
-            batch = await self.garage.k2v_item_table.get_range(
+            batch = await table.get_range(
                 part_pk, cursor, filt, 1000, reverse=reverse
             )
             if not batch:
                 return
             for item in batch:
-                if skip_past is not None and item.sort_key >= skip_past:
+                if skip_past is not None and sk_of(item) >= skip_past:
                     continue
                 yield item
-            last = batch[-1].sort_key
+            last = sk_of(batch[-1])
             if len(batch) < 1000:
                 return
             if reverse:
                 cursor, skip_past = last.encode(), last
             else:
                 cursor, skip_past = last.encode() + b"\x00", None
+
+    def _iter_partition(self, part_pk: bytes, begin_bytes, filt, reverse):
+        return self._iter_range(
+            self.garage.k2v_item_table, part_pk, begin_bytes, filt, reverse,
+            lambda item: item.sort_key,
+        )
 
     async def _delete_batch(self, bucket_id, request) -> web.Response:
         body = json.loads(await request.read())

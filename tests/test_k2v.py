@@ -527,3 +527,43 @@ def test_k2v_conflicts_only_beyond_first_page(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_k2v_read_index_end_reverse(tmp_path):
+    """ReadIndex end/reverse query params (reference index.rs)."""
+
+    async def main():
+        garage, s3, k2v, client = await k2v_daemon(tmp_path)
+        try:
+            await client.insert_batch(
+                [(pk, "s", b"v", None) for pk in ("pa", "pb", "pc", "qa")]
+            )
+            for _ in range(100):
+                idx = await client.read_index()
+                if len(idx["partitionKeys"]) == 4:
+                    break
+                await asyncio.sleep(0.1)
+
+            async def ri(**params):
+                st, _h, data = await client._req(
+                    "GET", "/k2vtest",
+                    query=[(k, str(v)) for k, v in params.items()],
+                )
+                import json as _json
+
+                assert st == 200, data
+                return [p["pk"] for p in _json.loads(data)["partitionKeys"]]
+
+            assert await ri(end="pc") == ["pa", "pb"]
+            assert await ri(reverse="true") == ["qa", "pc", "pb", "pa"]
+            assert await ri(prefix="p", reverse="true") == ["pc", "pb", "pa"]
+            assert await ri(reverse="true", start="pb", end="aa") == ["pb", "pa"]
+            # reverse: start is an UPPER bound — with start below the
+            # prefix range nothing matches
+            assert await ri(reverse="true", start="a", prefix="p") == []
+        finally:
+            await client.close()
+            await k2v.stop()
+            await teardown(garage, s3)
+
+    run(main())
