@@ -125,8 +125,12 @@ class LifecycleWorker(Worker):
                     except ValueError:
                         pass
                 if expired:
+                    # strictly past every existing version, like the API
+                    # delete path — a skew-dated version must not outrank
+                    # its own expiration
+                    dm_ts = max(now, max(v.timestamp for v in obj.versions) + 1)
                     dm = ObjectVersion(
-                        gen_uuid(), now, "complete", {"t": "delete_marker"}
+                        gen_uuid(), dm_ts, "complete", {"t": "delete_marker"}
                     )
                     await self.garage.object_table.insert(
                         Object(obj.bucket_id, obj.key, [dm])

@@ -1448,3 +1448,81 @@ def test_list_uploads_prefix_marker_no_duplicates(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_lifecycle_worker_expires_and_aborts(tmp_path):
+    """The daily lifecycle pass expires old objects (delete marker) and
+    aborts stale multipart uploads (reference s3/lifecycle_worker.rs)."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("cycle")
+            bid = await garage.helper.resolve_bucket("cycle")
+            b = await garage.helper.get_bucket(bid)
+            b.params().lifecycle.update(
+                [
+                    {"prefix": "tmp/", "enabled": True, "expiration_days": 7},
+                    {"enabled": True, "abort_mpu_days": 3},
+                ]
+            )
+            await garage.bucket_table.insert(b)
+
+            # plant: an 8-day-old object under tmp/, a fresh one, and a
+            # 5-day-old in-flight multipart upload
+            from garage_tpu.model.s3.lifecycle_worker import LifecycleWorker
+            from garage_tpu.model.s3.object_table import Object, ObjectVersion
+            from garage_tpu.utils.background import WorkerState
+            from garage_tpu.utils.data import gen_uuid
+            from garage_tpu.utils.time_util import now_msec
+
+            day = 86_400_000
+            old = ObjectVersion(
+                gen_uuid(), now_msec() - 8 * day, "complete",
+                {"t": "inline", "bytes": b"old",
+                 "meta": {"size": 3, "etag": "0" * 32, "headers": []}},
+            )
+            await garage.object_table.insert(Object(bid, "tmp/old.txt", [old]))
+            await client.put_object("cycle", "tmp/fresh.txt", b"fresh")
+            # plant a 5-day-old in-flight multipart upload directly
+            from garage_tpu.model.s3.mpu_table import MultipartUpload
+
+            stale_uid = gen_uuid()
+            old_ts = now_msec() - 5 * day
+            await garage.mpu_table.insert(
+                MultipartUpload(stale_uid, bid, "stale-up.bin", timestamp=old_ts)
+            )
+            await garage.object_table.insert(
+                Object(
+                    bid, "stale-up.bin",
+                    [ObjectVersion(
+                        stale_uid, old_ts, "uploading",
+                        {"t": "first_block", "vid": stale_uid, "mpu": True,
+                         "hdrs": []},
+                    )],
+                )
+            )
+
+            w = LifecycleWorker(garage)
+            for _ in range(50):
+                if await w.work() == WorkerState.IDLE:
+                    break
+
+            # expired object is gone; fresh one remains
+            with pytest.raises(S3Error):
+                await client.get_object("cycle", "tmp/old.txt")
+            assert await client.get_object("cycle", "tmp/fresh.txt") == b"fresh"
+            # the stale upload was aborted: no longer listed, mpu deleted
+            st, _h, data = await client._req(
+                "GET", "/cycle", query=[("uploads", "")]
+            )
+            assert b"stale-up.bin" not in data
+            mpu = await garage.mpu_table.get(stale_uid, b"")
+            assert mpu.deleted.get()
+            # second pass same day: idempotent (nothing left to do)
+            assert await w.work() == WorkerState.IDLE
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
