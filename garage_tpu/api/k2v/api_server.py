@@ -411,36 +411,58 @@ class K2VApiServer:
         )
 
     async def _delete_batch(self, bucket_id, request) -> web.Response:
+        """DeleteBatch with the reference query shape (batch.rs
+        DeleteBatchQuery): prefix, start, end, singleItem — streamed over
+        the full range via the shared enumeration."""
         body = json.loads(await request.read())
+        # validate EVERY query item before mutating anything — a malformed
+        # later entry must not leave earlier deletions half-applied
+        for d in body:
+            d["partitionKey"]  # KeyError -> 400 before any delete
+            if d.get("singleItem") and d.get("start") is None:
+                raise ValueError("singleItem requires start")
         deleted = []
         for d in body:
             pk = d["partitionKey"]
+            prefix = d.get("prefix")
             start = d.get("start")
             end = d.get("end")
             single = d.get("singleItem", False)
             n = 0
-            cursor = start.encode() if start else None
-            while True:  # page through the FULL range
-                items = await self.garage.k2v_item_table.get_range(
-                    bucket_id + pk.encode(), cursor, "present", 1000
+            if single:
+                item = await self.garage.k2v_item_table.get(
+                    bucket_id + pk.encode(), start.encode()
                 )
-                done = True
-                for item in items:
-                    if cursor is not None and item.sort_key.encode() < cursor:
-                        continue
-                    if single and item.sort_key != start:
-                        continue
-                    if end is not None and item.sort_key >= end:
-                        break
+                if item is not None and not item.is_tombstone():
                     await self.garage.k2v_rpc.insert(
-                        bucket_id, pk, item.sort_key, item.causal_context(), None
+                        bucket_id, pk, start, item.causal_context(), None
                     )
+                    n = 1
+            else:
+                # collect tombstones and flush in bounded-concurrency
+                # batches — one sequential quorum RPC per item would make
+                # big range deletes N x RTT
+                pending: list = []
+                async for item in self._iter_partition(
+                    bucket_id + pk.encode(),
+                    self._range_begin(prefix, start, False),
+                    "present",
+                    False,
+                ):
+                    sk = item.sort_key
+                    if prefix is not None and not sk.startswith(prefix):
+                        if sk > prefix:
+                            break
+                        continue
+                    if end is not None and sk >= end:
+                        break
+                    pending.append((pk, sk, item.causal_context(), None))
                     n += 1
-                else:
-                    done = len(items) < 1000
-                if done or single:
-                    break
-                cursor = items[-1].sort_key.encode() + b"\x00"
+                    if len(pending) >= 256:
+                        await self.garage.k2v_rpc.insert_batch(bucket_id, pending)
+                        pending = []
+                if pending:
+                    await self.garage.k2v_rpc.insert_batch(bucket_id, pending)
             deleted.append({"partitionKey": pk, "deletedItems": n})
         return web.json_response(deleted)
 

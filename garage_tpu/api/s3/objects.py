@@ -71,16 +71,8 @@ def _check_sha256(ctx, digest: "hashlib._Hash") -> None:
             )
 
 
-def next_timestamp(existing: Object | None) -> int:
-    """Version timestamp for a new write: strictly after every version the
-    key already has, even if a clock-skewed node wrote one in the future
-    (reference put.rs:698 next_timestamp — without this, a delete issued
-    after a future-dated write would lose the LWW race and the object
-    would be undeletable until wall clocks catch up)."""
-    ts = now_msec()
-    if existing is not None and existing.versions:
-        ts = max(ts, max(v.timestamp for v in existing.versions) + 1)
-    return ts
+# canonical implementation lives with the CRDT it protects
+from ...model.s3.object_table import next_timestamp  # noqa: E402,F401
 
 
 async def check_quotas(

@@ -291,10 +291,9 @@ class AdminRpcHandler:
             raise ValueError("refusing to purge without yes=true")
         g = self.garage
         h = self._resolve_block_hash(args["hash"])
-        from ..model.s3.object_table import Object, ObjectVersion
+        from ..model.s3.object_table import Object, ObjectVersion, next_timestamp
         from ..model.s3.version_table import Version
         from ..utils.data import gen_uuid
-        from ..utils.time_util import now_msec
 
         versions = objects = 0
         async for ref in self._iter_block_refs(h):
@@ -314,7 +313,8 @@ class AdminRpcHandler:
                 for v in obj.versions
             ):
                 dm = ObjectVersion(
-                    gen_uuid(), now_msec(), "complete", {"t": "delete_marker"}
+                    gen_uuid(), next_timestamp(obj), "complete",
+                    {"t": "delete_marker"},
                 )
                 await g.object_table.insert(
                     Object(ver.bucket_id, ver.key, [dm])

@@ -128,9 +128,11 @@ class LifecycleWorker(Worker):
                     # strictly past every existing version, like the API
                     # delete path — a skew-dated version must not outrank
                     # its own expiration
-                    dm_ts = max(now, max(v.timestamp for v in obj.versions) + 1)
+                    from .object_table import next_timestamp
+
                     dm = ObjectVersion(
-                        gen_uuid(), dm_ts, "complete", {"t": "delete_marker"}
+                        gen_uuid(), next_timestamp(obj), "complete",
+                        {"t": "delete_marker"},
                     )
                     await self.garage.object_table.insert(
                         Object(obj.bucket_id, obj.key, [dm])

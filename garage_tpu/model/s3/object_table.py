@@ -104,6 +104,21 @@ class Object:
         return [self.bucket_id, self.key, [v.to_obj() for v in self.versions]]
 
 
+def next_timestamp(existing: "Object | None") -> int:
+    """Version timestamp for a new write: strictly after every version the
+    key already has, even if a clock-skewed node wrote one in the future
+    (reference put.rs:698 next_timestamp — without this, a delete issued
+    after a future-dated write would lose the LWW race and the object
+    would be undeletable until wall clocks catch up).  Shared by the API
+    write paths, the lifecycle worker, and block purge."""
+    from ...utils.time_util import now_msec
+
+    ts = now_msec()
+    if existing is not None and existing.versions:
+        ts = max(ts, max(v.timestamp for v in existing.versions) + 1)
+    return ts
+
+
 def object_counts(e: "Object | None") -> dict[str, int]:
     """Counter deltas source (reference object_table.rs counts())."""
     if e is None:

@@ -567,3 +567,39 @@ def test_k2v_read_index_end_reverse(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_k2v_delete_batch_prefix_and_single(tmp_path):
+    """DeleteBatch prefix ranges and singleItem (reference batch.rs
+    DeleteBatchQuery)."""
+
+    async def main():
+        garage, s3, k2v, client = await k2v_daemon(tmp_path)
+        try:
+            await client.insert_batch(
+                [(f"dp", sk, b"v", None) for sk in ("a1", "a2", "b1", "b2", "c")]
+            )
+            dels = await client.delete_batch(
+                [{"partitionKey": "dp", "prefix": "a"}]
+            )
+            assert dels[0]["deletedItems"] == 2
+            res = (await client.read_batch([{"partitionKey": "dp"}]))[0]
+            assert [i["sk"] for i in res["items"]] == ["b1", "b2", "c"]
+
+            dels = await client.delete_batch(
+                [{"partitionKey": "dp", "start": "b1", "singleItem": True}]
+            )
+            assert dels[0]["deletedItems"] == 1
+            res = (await client.read_batch([{"partitionKey": "dp"}]))[0]
+            assert [i["sk"] for i in res["items"]] == ["b2", "c"]
+            # deleting an already-deleted single item is a no-op
+            dels = await client.delete_batch(
+                [{"partitionKey": "dp", "start": "b1", "singleItem": True}]
+            )
+            assert dels[0]["deletedItems"] == 0
+        finally:
+            await client.close()
+            await k2v.stop()
+            await teardown(garage, s3)
+
+    run(main())
