@@ -46,12 +46,24 @@ class Endpoint:
         from ..utils.metrics import registry
         from ..utils.tracing import span
 
+        lbl = (("endpoint", self.path),)
+        registry.incr("rpc_request_counter", lbl + (("to", target.hex()[:16]),))
         with span("rpc:" + self.path, to=target.hex()[:16]):
-            with registry.timer("rpc_request_duration", (("endpoint", self.path),)):
-                return await self.netapp.call(
-                    target, self.path, Req(msg, stream=stream, order_tag=order_tag),
-                    prio=prio, timeout=timeout,
-                )
+            with registry.timer("rpc_request_duration", lbl):
+                try:
+                    return await self.netapp.call(
+                        target, self.path,
+                        Req(msg, stream=stream, order_tag=order_tag),
+                        prio=prio, timeout=timeout,
+                    )
+                except asyncio.TimeoutError:
+                    # reference exports rpc_timeout_counter separately from
+                    # generic errors (src/rpc/rpc_helper.rs:172-217)
+                    registry.incr("rpc_timeout_counter", lbl)
+                    raise
+                except Exception:
+                    registry.incr("rpc_error_counter", lbl)
+                    raise
 
 
 class NetApp:

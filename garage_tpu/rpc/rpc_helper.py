@@ -20,6 +20,7 @@ from ..net.message import PRIO_NORMAL
 from ..net.netapp import Endpoint
 from ..utils.background import spawn
 from ..utils.error import Quorum
+from ..utils.metrics import registry
 
 logger = logging.getLogger("garage.rpc")
 
@@ -104,7 +105,9 @@ class RpcHelper:
         preference order, staggering extras only when replies are slow —
         the read path optimization that keeps traffic off far nodes."""
         nodes = self.request_order(nodes)
+        lbl = (("endpoint", endpoint.path),)
         if quorum > len(nodes):
+            registry.incr("rpc_quorum_error_counter", lbl)
             raise Quorum(quorum, 0, [f"only {len(nodes)} candidate nodes"])
         timeout = timeout or self.default_timeout
 
@@ -129,6 +132,7 @@ class RpcHelper:
         try:
             while len(results) < quorum:
                 if not pending:
+                    registry.incr("rpc_quorum_error_counter", lbl)
                     raise Quorum(quorum, len(results), errors)
                 wait_timeout = None if all_at_once else STAGGER_DELAY
                 done, _ = await asyncio.wait(
@@ -138,6 +142,7 @@ class RpcHelper:
                 )
                 if not done and next_idx < len(nodes):
                     # slow: stagger one more request
+                    registry.incr("rpc_stagger_launch_counter", lbl)
                     launch(nodes[next_idx])
                     next_idx += 1
                     continue
@@ -177,7 +182,9 @@ class RpcHelper:
         `quorum` successes.  Remaining in-flight requests are left running
         in the background (they still deliver the write to slow nodes)."""
         timeout = timeout or self.default_timeout
+        lbl = (("endpoint", endpoint.path),)
         if not write_sets or all(not s for s in write_sets):
+            registry.incr("rpc_quorum_error_counter", lbl)
             raise Quorum(quorum, 0, ["no write sets (layout has no nodes yet)"])
         all_nodes: list[bytes] = []
         for s in write_sets:
@@ -189,6 +196,7 @@ class RpcHelper:
         # lowering the bar (reference rpc_helper.rs errors here too)
         for i, s in enumerate(write_sets):
             if len(s) < quorum:
+                registry.incr("rpc_quorum_error_counter", lbl)
                 raise Quorum(
                     quorum,
                     0,
@@ -233,6 +241,7 @@ class RpcHelper:
             for t in tasks:
                 t.cancel()
             got = min(set_success) if set_success else 0
+            registry.incr("rpc_quorum_error_counter", lbl)
             raise Quorum(quorum, got, errors)
         # leftover requests continue in the background
         leftover = [t for t in tasks if not t.done()]

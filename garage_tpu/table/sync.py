@@ -20,6 +20,7 @@ import time
 
 from ..net.message import PRIO_BACKGROUND, Req, Resp
 from ..utils.background import Worker, WorkerState
+from ..utils.metrics import registry
 
 logger = logging.getLogger("garage.table.sync")
 
@@ -51,6 +52,11 @@ class TableSyncer:
         if op[0] == "Node":
             return Resp(self.merkle.get_node(int(op[1]), bytes(op[2])))
         if op[0] == "Items":
+            registry.incr(
+                "table_sync_items_received",
+                (("table_name", self.table.schema.table_name),),
+                by=len(op[1]),
+            )
             for v in op[1]:
                 self.data.update_entry(bytes(v))
             return Resp(None)
@@ -122,11 +128,16 @@ class TableSyncer:
             if v is not None:
                 values.append(v)
         for i in range(0, len(values), ITEMS_BATCH):
+            batch = values[i : i + ITEMS_BATCH]
             await self.endpoint.call(
-                node,
-                ["Items", values[i : i + ITEMS_BATCH]],
-                prio=PRIO_BACKGROUND,
-                timeout=60.0,
+                node, ["Items", batch], prio=PRIO_BACKGROUND, timeout=60.0
+            )
+            # count per delivered batch, so a push that dies midway still
+            # reports the items that actually reached the peer
+            registry.incr(
+                "table_sync_items_sent",
+                (("table_name", self.table.schema.table_name),),
+                by=len(batch),
             )
         return len(values)
 

@@ -16,6 +16,7 @@ from ..net.message import PRIO_NORMAL, Req, Resp
 from ..rpc.rpc_helper import RpcHelper
 from ..rpc.system import System
 from ..utils.background import BackgroundRunner, spawn
+from ..utils.metrics import registry
 from ..utils.serde import pack
 from .data import TableData
 from .gc import TableGc
@@ -47,6 +48,9 @@ class Table:
         self.endpoint.set_handler(self._handle)
         self.syncer = TableSyncer(self)
         self.gc = TableGc(self)
+        # per-table op metrics (reference src/table/metrics.rs:
+        # table_get/put_request_counter+duration, internal update counter)
+        self._mlbl = (("table_name", schema.table_name),)
 
     def spawn_workers(self, bg: BackgroundRunner) -> None:
         bg.spawn(MerkleWorker(self.merkle))
@@ -64,8 +68,10 @@ class Table:
         active layout version's node set (reference table.rs:106-139)."""
         from ..utils.tracing import span
 
+        registry.incr("table_put_request_counter", self._mlbl)
         with span("table:insert", table=self.schema.table_name, n=len(entries)):
-            await self._insert_many(entries)
+            with registry.timer("table_put_request_duration", self._mlbl):
+                await self._insert_many(entries)
 
     async def _insert_many(self, entries: list) -> None:
         by_sets: dict[bytes, tuple[list[list[bytes]], list[bytes]]] = {}
@@ -99,8 +105,10 @@ class Table:
     async def get(self, pk: bytes, sk: bytes):
         from ..utils.tracing import span
 
+        registry.incr("table_get_request_counter", self._mlbl)
         with span("table:get", table=self.schema.table_name):
-            return await self._get(pk, sk)
+            with registry.timer("table_get_request_duration", self._mlbl):
+                return await self._get(pk, sk)
 
     async def _get(self, pk: bytes, sk: bytes):
         h = self.schema.partition_hash(pk)
@@ -130,16 +138,18 @@ class Table:
         limit: int = 1000,
         reverse: bool = False,
     ) -> list:
+        registry.incr("table_range_request_counter", self._mlbl)
         h = self.schema.partition_hash(pk)
         nodes = self.replication.read_nodes(h)
         quorum = self.replication.read_quorum()
-        resps = await self.helper.try_call_many(
-            self.endpoint,
-            nodes,
-            ["RR", pk, start_sk, filt, limit, reverse],
-            quorum=quorum,
-            all_at_once=False,
-        )
+        with registry.timer("table_range_request_duration", self._mlbl):
+            resps = await self.helper.try_call_many(
+                self.endpoint,
+                nodes,
+                ["RR", pk, start_sk, filt, limit, reverse],
+                quorum=quorum,
+                all_at_once=False,
+            )
         merged: dict[bytes, Any] = {}
         seen_values: dict[bytes, set[bytes]] = {}
         for r in resps:
@@ -213,6 +223,9 @@ class Table:
     async def _handle(self, from_id: bytes, req: Req) -> Resp:
         op = req.body
         if op[0] == "U":
+            registry.incr(
+                "table_internal_update_counter", self._mlbl, by=len(op[1])
+            )
             for v in op[1]:
                 self.data.update_entry(bytes(v))
             return Resp(None)

@@ -70,6 +70,14 @@ def test_daemon_metrics_endpoint_has_gauges_and_histograms(tmp_path):
             assert 'api_s3_request_duration_bucket' in text
             assert 'le="+Inf"' in text
             assert "cluster_connected_nodes 0" in text
+            # per-endpoint rpc + per-table op families (reference
+            # rpc_helper.rs:172-217, monitoring.md): the PUT/GET above
+            # drove table + block endpoints through the rpc layer
+            assert 'rpc_request_counter{endpoint=' in text
+            assert 'rpc_request_duration_bucket{endpoint=' in text
+            assert 'table_put_request_counter{table_name=' in text
+            assert 'table_put_request_duration_bucket{table_name=' in text
+            assert 'table_internal_update_counter{table_name=' in text
         finally:
             await admin.stop()
             await teardown(garage, s3)

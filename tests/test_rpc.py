@@ -104,9 +104,25 @@ def test_try_call_many_quorum():
             # quorum 2 of 3 succeeds despite node 1 failing
             res = await helper.try_call_many(ep, nodes, "x", quorum=2)
             assert sorted(res_bodies(res)) == ["ok0", "ok2"]
-            # quorum 3 of 3 cannot be reached
+            # quorum 3 of 3 cannot be reached — and the failure is counted
+            # per-endpoint (reference rpc_helper.rs:172-217 metric family)
+            from garage_tpu.utils.metrics import registry
+
+            qlbl = ("rpc_quorum_error_counter", (("endpoint", "t/q"),))
+            q0 = registry.counters.get(qlbl, 0)
+            e0 = registry.counters.get(
+                ("rpc_error_counter", (("endpoint", "t/q"),)), 0
+            )
             with pytest.raises(Quorum):
                 await helper.try_call_many(ep, nodes, "x", quorum=3)
+            assert registry.counters[qlbl] == q0 + 1
+            assert registry.counters[
+                ("rpc_error_counter", (("endpoint", "t/q"),))
+            ] > e0, "node 1's failures should increment rpc_error_counter"
+            assert any(
+                k[0] == "rpc_request_counter" and k[1][0] == ("endpoint", "t/q")
+                for k in registry.counters
+            )
         finally:
             await stop_cluster(apps, systems)
 
