@@ -550,8 +550,23 @@ async def handle_get_object(
     start, end = rng if rng is not None else (0, size)
     resp = web.StreamResponse(status=status, headers=headers)
     await resp.prepare(request)
-    async for chunk in plain_block_stream(garage, blocks, start, end, enc_params):
-        await resp.write(chunk)
+    try:
+        async for chunk in plain_block_stream(
+            garage, blocks, start, end, enc_params
+        ):
+            await resp.write(chunk)
+    except Exception as e:  # noqa: BLE001
+        # 200 + Content-Length are already on the wire, so an error
+        # document can no longer be sent — abort the connection so the
+        # client sees a truncated transfer NOW instead of waiting out its
+        # own timeout on a body that will never complete (the error
+        # middleware would otherwise try to send a second response on
+        # this same connection)
+        logger.warning("aborting GET mid-stream: %r", e)
+        resp.force_close()
+        if request.transport is not None:
+            request.transport.close()
+        return resp
     await resp.write_eof()
     return resp
 

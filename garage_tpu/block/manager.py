@@ -436,19 +436,17 @@ class BlockManager:
                     stream_factory=lambda: bytes_stream(stored),
                 )
             return
-        # EC: one distinct piece per node rank; pieces are not compressed
-        # (parity shards don't compress; data shards rarely worth it)
+        # EC: one distinct piece per node rank, placed in EVERY active
+        # layout version (the EC analog of try_write_many_sets, reference
+        # rpc_helper.rs:432-533): a block written mid-migration must be
+        # decodable even if either version's node set dies afterwards.
+        # Pieces are not compressed (parity shards don't compress; data
+        # shards rarely worth it).
         pieces = self.codec.encode(data)
-        nodes = layout.current().nodes_of(hash32)
-        if len(nodes) < self.codec.n_pieces:
-            raise Error(
-                f"EC({self.codec.min_pieces},"
-                f"{self.codec.n_pieces - self.codec.min_pieces}) needs "
-                f"{self.codec.n_pieces} nodes per block, layout assigns "
-                f"{len(nodes)}"
-            )
-        targets = list(enumerate(nodes[: self.codec.n_pieces]))
-        async with self.buffers.reserve(sum(len(p) for p in pieces)):
+        send_targets, per_version = self._ec_piece_targets(hash32, layout)
+        async with self.buffers.reserve(
+            sum(len(pieces[i]) for _n, i in send_targets)
+        ):
             results = await asyncio.gather(
                 *[
                     self.endpoint.call(
@@ -459,28 +457,63 @@ class BlockManager:
                         prio=PRIO_NORMAL,
                         stream=bytes_stream(pieces[i]),
                     )
-                    for i, n in targets
+                    for n, i in send_targets
                 ],
                 return_exceptions=True,
             )
-        # quorum counts DISTINCT pieces stored; tolerate up to half the
-        # parity pieces missing at write time (resync rebuilds them)
-        distinct_ok = {
-            i for (i, _n), r in zip(targets, results) if not isinstance(r, Exception)
+        ok = {
+            t for t, r in zip(send_targets, results)
+            if not isinstance(r, Exception)
         }
+        # quorum counts DISTINCT pieces stored per layout version; tolerate
+        # up to half the parity pieces missing (resync rebuilds them) — but
+        # EVERY active version's node set must independently reach quorum
         m = self.codec.n_pieces - self.codec.min_pieces
         quorum_pieces = self.codec.n_pieces - m // 2
-        if len(distinct_ok) < quorum_pieces:
-            raise Quorum(
-                quorum_pieces,
-                len(distinct_ok),
-                [repr(r) for r in results if isinstance(r, Exception)],
-            )
+        for vt in per_version:
+            distinct_ok = {i for (n, i) in vt if (n, i) in ok}
+            if len(distinct_ok) < quorum_pieces:
+                raise Quorum(
+                    quorum_pieces,
+                    len(distinct_ok),
+                    [repr(r) for r in results if isinstance(r, Exception)],
+                )
         # pieces that failed their primary node heal via resync
-        for (i, _n), r in zip(targets, results):
-            if isinstance(r, Exception):
-                self.resync.queue_block(hash32)
-                break
+        if len(ok) < len(send_targets):
+            self.resync.queue_block(hash32)
+
+    def _ec_piece_targets(
+        self, hash32: bytes, layout
+    ) -> tuple[list[tuple[bytes, int]], list[list[tuple[bytes, int]]]]:
+        """Piece placement spanning all active layout versions.
+
+        Returns (send_targets, per_version): `send_targets` is the deduped
+        list of (node, piece_rank) sends — a node keeps the same piece if
+        its rank agrees across versions, and receives several pieces when
+        it doesn't; `per_version` holds each version's (node, piece) list
+        for independent quorum accounting (reference
+        src/rpc/rpc_helper.rs:432-533 multi-set write guarantee)."""
+        versions = [v for v in layout.versions if v.ring_assignment]
+        if not versions:
+            # zero versions would mean zero sends below — a silent
+            # durability lie; fail like the replica path does
+            raise Error("no layout version with a ring assignment yet")
+        seen: dict[tuple[bytes, int], None] = {}
+        per_version: list[list[tuple[bytes, int]]] = []
+        for v in versions:
+            nodes = v.nodes_of(hash32)
+            if len(nodes) < self.codec.n_pieces:
+                raise Error(
+                    f"EC({self.codec.min_pieces},"
+                    f"{self.codec.n_pieces - self.codec.min_pieces}) needs "
+                    f"{self.codec.n_pieces} nodes per block, layout v"
+                    f"{v.version} assigns {len(nodes)}"
+                )
+            vt = [(nodes[i], i) for i in range(self.codec.n_pieces)]
+            per_version.append(vt)
+            for t in vt:
+                seen.setdefault(t)
+        return list(seen), per_version
 
     async def rpc_get_block(self, hash32: bytes, prio: int = PRIO_NORMAL) -> bytes:
         """Fetch a block: local first, then peers in latency order with
@@ -545,8 +578,15 @@ class BlockManager:
     async def gather_pieces(
         self, hash32: bytes, want_k: int, prio=PRIO_NORMAL, exclude_self=False
     ) -> tuple[int, dict[int, bytes]]:
-        """Collect at least want_k distinct pieces -> (block_len, pieces)."""
-        nodes = self.system.layout_manager.history.current().nodes_of(hash32)
+        """Collect at least want_k distinct pieces -> (block_len, pieces).
+
+        Fast path assumes rank-i placement in the current layout version;
+        the slow path asks every node of EVERY active version what it
+        holds, so blocks written mid-migration (pieces spanning versions)
+        stay readable whichever node set survives."""
+        layout = self.system.layout_manager.history
+        nodes = layout.current().nodes_of(hash32)
+        all_nodes = self.storage_nodes_of(hash32)  # union of active versions
         pieces: dict[int, bytes] = {}
         block_len = -1
         errors: list[str] = []
@@ -566,7 +606,7 @@ class BlockManager:
                 block_len, pieces[i] = r
         if len(pieces) < want_k:
             # slow path: ask every node which pieces it holds, take any k
-            for n in self.helper.request_order(nodes):
+            for n in self.helper.request_order(all_nodes):
                 if len(pieces) >= want_k:
                     break
                 if exclude_self and n == self.system.id:
@@ -615,12 +655,17 @@ class BlockManager:
     async def reconstruct_local_piece(self, hash32: bytes) -> bool:
         """Rebuild THIS node's piece from surviving peers (EC resync path).
         Returns True if a piece was stored."""
-        nodes = self.system.layout_manager.history.current().nodes_of(hash32)
-        try:
-            my_rank = nodes.index(self.system.id)
-        except ValueError:
-            return False
-        if my_rank >= self.codec.n_pieces:
+        layout = self.system.layout_manager.history
+        my_rank = None
+        # newest version first: the current rank is this node's primary
+        # piece; during a migration an old-version rank still counts (the
+        # piece remains readable there until the transition completes)
+        for v in reversed([v for v in layout.versions if v.ring_assignment]):
+            nodes = v.nodes_of(hash32)
+            if self.system.id in nodes[: self.codec.n_pieces]:
+                my_rank = nodes.index(self.system.id)
+                break
+        if my_rank is None:
             return False
         blen, pieces = await self.gather_pieces(
             hash32, self.codec.min_pieces, prio=PRIO_BACKGROUND, exclude_self=True

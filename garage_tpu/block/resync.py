@@ -114,10 +114,21 @@ class BlockResyncManager:
         i_store = mgr.system.id in mgr.storage_nodes_of(hash32)
 
         if mgr.codec.n_pieces > 1:
-            # EC mode: this node's unit of storage is ITS piece
-            nodes = mgr.system.layout_manager.history.current().nodes_of(hash32)
-            my_rank = nodes.index(mgr.system.id) if mgr.system.id in nodes else -1
-            is_holder = 0 <= my_rank < mgr.codec.n_pieces
+            # EC mode: this node's unit of storage is ITS piece.  A node
+            # is a holder if it ranks < n_pieces in ANY active layout
+            # version — an old-version holder must NOT drop its piece
+            # while a migration is open (the multi-set write guarantee
+            # says either version's set alone can decode); it hands off
+            # only after trim retires the old version.
+            layout = mgr.system.layout_manager.history
+            nodes = layout.current().nodes_of(hash32)
+            my_rank = None
+            for v in reversed([v for v in layout.versions if v.ring_assignment]):
+                nodes_v = v.nodes_of(hash32)
+                if mgr.system.id in nodes_v[: mgr.codec.n_pieces]:
+                    my_rank = nodes_v.index(mgr.system.id)
+                    break
+            is_holder = my_rank is not None
             local = mgr.local_pieces(hash32)
             if needed and is_holder and my_rank not in local:
                 await mgr.reconstruct_local_piece(hash32)
@@ -214,6 +225,95 @@ class BlockResyncManager:
     def spawn_workers(self, bg: BackgroundRunner) -> None:
         for i in range(MAX_RESYNC_WORKERS):
             bg.spawn(_ResyncWorker(self, i))
+        bg.spawn(_LayoutSyncWorker(self))
+
+
+class _LayoutSyncWorker(Worker):
+    """The block plane's role in a layout transition.
+
+    On every new layout version, re-queue every locally-referenced block
+    so the resync logic migrates / hands off / reconstructs pieces for
+    the new assignment; once the scan is done AND the resync queue has
+    drained with no errored blocks, report the "block" sync component to
+    the layout manager.  Version retirement (LayoutHistory.trim) is
+    gated on this report exactly like on the table syncers' — without
+    it, old versions could be retired while blocks still live only on
+    the outgoing node set, stranding acked data (see
+    doc/ec-placement.md "When does a transition complete?")."""
+
+    SCAN_BATCH = 200
+
+    def __init__(self, resync: BlockResyncManager):
+        self.resync = resync
+        self.lm = resync.manager.system.layout_manager
+        self.lm.register_sync_component("block")
+        self._changed = asyncio.Event()
+        self._changed.set()  # initial pass reports the boot version
+        self._last_seen = self.lm.history.current().version
+        self.lm.subscribe(self._on_layout_change)
+        self._version: int | None = None  # version currently being driven
+        self._cursor: bytes | None = None  # rc-table scan position
+        self._queued = 0
+
+    def _on_layout_change(self) -> None:
+        # trigger only on NEW versions — tracker gossip also notifies,
+        # and re-scanning on every tracker advance would loop forever
+        v = self.lm.history.current().version
+        if v != self._last_seen:
+            self._last_seen = v
+            self._changed.set()
+
+    def name(self) -> str:
+        return "block layout sync"
+
+    def status(self):
+        return {
+            "version": self._version,
+            "queued": self._queued,
+            "scanning": self._cursor is not None,
+        }
+
+    async def work(self):
+        mgr = self.resync.manager
+        if self._changed.is_set():
+            self._changed.clear()
+            h = self.lm.history
+            self._version = h.current().version
+            self._queued = 0
+            active = [v for v in h.versions if v.ring_assignment]
+            if (
+                len(active) <= 1
+                and h.sync.get(mgr.system.id) >= self._version
+            ):
+                # plain restart of an already-synced node: report without
+                # sweeping the whole rc table
+                self._cursor = None
+            else:
+                self._cursor = b""
+        if self._version is None:
+            return WorkerState.IDLE
+        if self._cursor is not None:
+            n = 0
+            for key, _v in mgr.rc.tree.iter_range(start=self._cursor):
+                self.resync.queue_block(key)
+                self._cursor = key + b"\x00"
+                self._queued += 1
+                n += 1
+                if n >= self.SCAN_BATCH:
+                    await asyncio.sleep(0)  # yield: the scan is sync code
+                    return WorkerState.BUSY
+            self._cursor = None
+            return WorkerState.BUSY
+        if self.resync.queue_len() == 0 and self.resync.errors_len() == 0:
+            self.lm.component_synced("block", self._version)
+            self._version = None
+        return WorkerState.IDLE
+
+    async def wait_for_work(self) -> None:
+        try:
+            await asyncio.wait_for(self._changed.wait(), timeout=2.0)
+        except asyncio.TimeoutError:
+            pass
 
 
 class _ResyncWorker(Worker):

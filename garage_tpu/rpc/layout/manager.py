@@ -50,6 +50,13 @@ class LayoutManager:
         # merge_remote/local_update are synchronous on the event loop, which
         # is what serializes them — no lock needed
         self.change_listeners: list[Callable[[], None]] = []
+        # layout-sync coordination (reference src/rpc/layout/manager.rs:
+        # each table syncer reports completed sync rounds; once EVERY
+        # registered component has synced up to version v, this node's
+        # sync tracker advances, which — gossiped and acked by the other
+        # nodes — lets trim() retire old versions and read_version() move
+        # forward).  name -> highest cleanly-synced layout version.
+        self._sync_components: dict[str, int] = {}
 
     # --- local views ---------------------------------------------------------
 
@@ -111,3 +118,22 @@ class LayoutManager:
 
     def mark_synced(self, version: int | None = None) -> None:
         self.local_update(lambda h: h.mark_synced(self.node_id, version))
+
+    # --- sync completion tracking --------------------------------------------
+
+    def register_sync_component(self, name: str) -> None:
+        """Declare a component whose sync completion gates layout-version
+        retirement.  All components must be registered before workers
+        start reporting (Garage wires every table before spawn)."""
+        self._sync_components.setdefault(name, 0)
+
+    def component_synced(self, name: str, version: int) -> None:
+        """A component finished a CLEAN sync round that began at layout
+        `version`; advance this node's sync tracker to the minimum across
+        all components."""
+        if self._sync_components.get(name, 0) >= version:
+            return
+        self._sync_components[name] = version
+        v = min(self._sync_components.values())
+        if v > self.history.sync.get(self.node_id):
+            self.mark_synced(v)

@@ -27,6 +27,12 @@ logger = logging.getLogger("garage.rpc")
 STAGGER_DELAY = 0.2  # launch an extra request if no reply within this
 
 
+def _quorum_fail(lbl: tuple, quorum: int, got: int, errors: list[str]):
+    """Count + raise in one place so no Quorum path misses the metric."""
+    registry.incr("rpc_quorum_error_counter", lbl)
+    raise Quorum(quorum, got, errors)
+
+
 class RpcHelper:
     def __init__(self, our_id: bytes, peering, default_timeout: float = 30.0):
         self.our_id = our_id
@@ -107,8 +113,7 @@ class RpcHelper:
         nodes = self.request_order(nodes)
         lbl = (("endpoint", endpoint.path),)
         if quorum > len(nodes):
-            registry.incr("rpc_quorum_error_counter", lbl)
-            raise Quorum(quorum, 0, [f"only {len(nodes)} candidate nodes"])
+            _quorum_fail(lbl, quorum, 0, [f"only {len(nodes)} candidate nodes"])
         timeout = timeout or self.default_timeout
 
         results: list[Any] = []
@@ -132,8 +137,7 @@ class RpcHelper:
         try:
             while len(results) < quorum:
                 if not pending:
-                    registry.incr("rpc_quorum_error_counter", lbl)
-                    raise Quorum(quorum, len(results), errors)
+                    _quorum_fail(lbl, quorum, len(results), errors)
                 wait_timeout = None if all_at_once else STAGGER_DELAY
                 done, _ = await asyncio.wait(
                     pending,
@@ -184,8 +188,7 @@ class RpcHelper:
         timeout = timeout or self.default_timeout
         lbl = (("endpoint", endpoint.path),)
         if not write_sets or all(not s for s in write_sets):
-            registry.incr("rpc_quorum_error_counter", lbl)
-            raise Quorum(quorum, 0, ["no write sets (layout has no nodes yet)"])
+            _quorum_fail(lbl, quorum, 0, ["no write sets (layout has no nodes yet)"])
         all_nodes: list[bytes] = []
         for s in write_sets:
             for n in s:
@@ -196,10 +199,8 @@ class RpcHelper:
         # lowering the bar (reference rpc_helper.rs errors here too)
         for i, s in enumerate(write_sets):
             if len(s) < quorum:
-                registry.incr("rpc_quorum_error_counter", lbl)
-                raise Quorum(
-                    quorum,
-                    0,
+                _quorum_fail(
+                    lbl, quorum, 0,
                     [f"write set {i} has only {len(s)} nodes (< quorum {quorum})"],
                 )
         set_success = [0] * len(write_sets)
@@ -241,8 +242,7 @@ class RpcHelper:
             for t in tasks:
                 t.cancel()
             got = min(set_success) if set_success else 0
-            registry.incr("rpc_quorum_error_counter", lbl)
-            raise Quorum(quorum, got, errors)
+            _quorum_fail(lbl, quorum, got, errors)
         # leftover requests continue in the background
         leftover = [t for t in tasks if not t.done()]
         if leftover:

@@ -39,6 +39,9 @@ class TableSyncer:
         self.endpoint.set_handler(self._handle)
         self._layout_changed = asyncio.Event()
         table.system.layout_manager.subscribe(self._on_layout_change)
+        table.system.layout_manager.register_sync_component(
+            f"table:{table.schema.table_name}"
+        )
 
     def _on_layout_change(self) -> None:
         self._layout_changed.set()
@@ -67,7 +70,7 @@ class TableSyncer:
     async def sync_all_partitions(self) -> dict:
         """One full anti-entropy round; returns stats."""
         me = self.table.system.id
-        stats = {"partitions": 0, "pushed": 0, "offloaded": 0}
+        stats = {"partitions": 0, "pushed": 0, "offloaded": 0, "errors": 0}
         owned = {p for p, _ in self.table.replication.local_partitions(me)}
         for p in sorted(owned):
             stats["partitions"] += 1
@@ -78,6 +81,7 @@ class TableSyncer:
                 try:
                     stats["pushed"] += await self._sync_with(p, node)
                 except Exception as e:  # noqa: BLE001
+                    stats["errors"] += 1
                     logger.debug("sync p%d with %s failed: %r", p, node.hex()[:8], e)
         # offload: local data in partitions we don't own
         await self._offload(owned, stats)
@@ -175,6 +179,7 @@ class TableSyncer:
                         )
                 except Exception as e:  # noqa: BLE001
                     ok = False
+                    stats["errors"] += 1
                     logger.debug("offload p%d to %s failed: %r", p, node.hex()[:8], e)
             if ok:
                 # hash-checked transactional delete: an entry updated while
@@ -215,7 +220,16 @@ class _SyncWorker(Worker):
         if not due:
             return WorkerState.IDLE
         self.last_sync = now
+        lm = self.syncer.table.system.layout_manager
+        # the round guarantees convergence only up to the version current
+        # when it STARTED; a layout applied mid-round re-triggers via
+        # _layout_changed, and the next round reports the newer version
+        v0 = lm.history.current().version
         self.last_stats = await self.syncer.sync_all_partitions()
+        if self.last_stats.get("errors", 0) == 0:
+            lm.component_synced(
+                f"table:{self.syncer.table.schema.table_name}", v0
+            )
         return WorkerState.IDLE
 
     async def wait_for_work(self) -> None:

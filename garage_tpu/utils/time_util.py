@@ -6,9 +6,20 @@ import time
 from datetime import datetime, timezone
 
 
+# fault-injection seam (chaos/jepsen clock nemesis): a test shifts the
+# whole process's notion of wall time to exercise the LWW/next_timestamp
+# logic under forward and BACKWARD clock jumps
+_offset_msec = 0
+
+
+def set_clock_offset(ms: int) -> None:
+    global _offset_msec
+    _offset_msec = ms
+
+
 def now_msec() -> int:
     """Milliseconds since the unix epoch."""
-    return int(time.time() * 1000)
+    return int(time.time() * 1000) + _offset_msec
 
 
 def increment_logical_clock(prev: int) -> int:

@@ -21,7 +21,12 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def make_ec_cluster(tmp_path, n=3, mode="ec:2:1", block_size=8192):
+async def make_ec_cluster(
+    tmp_path, n=3, mode="ec:2:1", block_size=8192, assign=None, spawn=True
+):
+    """`assign` limits the initial layout to those node indices (default
+    all); `spawn=False` skips background workers so a test can hold a
+    layout migration open (no sync rounds -> no version retirement)."""
     garages = []
     for i in range(n):
         cfg = config_from_dict(
@@ -52,6 +57,8 @@ async def make_ec_cluster(tmp_path, n=3, mode="ec:2:1", block_size=8192):
             break
     lm = garages[0].layout_manager
     for i, g in enumerate(garages):
+        if assign is not None and i not in assign:
+            continue
         lm.stage_role(g.node_id, NodeRole(zone=f"dc{i}", capacity=10**12))
     lm.apply_staged()
     for _ in range(100):
@@ -59,8 +66,9 @@ async def make_ec_cluster(tmp_path, n=3, mode="ec:2:1", block_size=8192):
         if all(g.layout_manager.digest() == lm.digest() for g in garages):
             break
     assert all(g.layout_manager.digest() == lm.digest() for g in garages)
-    for g in garages:
-        g.spawn_workers()
+    if spawn:
+        for g in garages:
+            g.spawn_workers()
     return garages
 
 

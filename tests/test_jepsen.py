@@ -1,0 +1,359 @@
+"""Jepsen-lite: history-checked consistency workloads under COMBINED
+nemeses (reference script/jepsen.garage/README.md:24-50 — reg2 register
+and set list-after-write workloads with partition + clock-scramble +
+layout-reconfig + node-crash nemeses running in one test).
+
+Unlike the chaos tests' eventual read-back, these record a full
+operation HISTORY (invoke/complete times, results) and check it:
+
+  reg2  - per-key single-writer versions; a read that returns an OLDER
+          version than a read that finished before it started is a
+          monotonicity violation; a read started after an acked write
+          finished must see at least that version (read/write quorums
+          of 2/3 intersect; LWW merge picks the max timestamp).
+  set2  - every acked insert (never deleted) must be in the final
+          listing; every acked delete must be absent.
+
+Nemeses all hit within one ~7s run: minority partition, +1h clock jump,
+layout reconfiguration, -30min BACKWARD clock jump, node crash+restart
+(sqlite persistence, real process state rebuilt from disk).
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_chaos import heal, partition  # noqa: E402
+
+from garage_tpu.api.s3.api_server import S3ApiServer  # noqa: E402
+from garage_tpu.api.s3.client import S3Client  # noqa: E402
+from garage_tpu.model.garage import Garage  # noqa: E402
+from garage_tpu.rpc.layout.types import NodeRole  # noqa: E402
+from garage_tpu.utils.config import config_from_dict  # noqa: E402
+from garage_tpu.utils.time_util import set_clock_offset  # noqa: E402
+
+N_REG_KEYS = 3
+RUN_SECONDS = 7.0
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def node_config(tmp_path, i, rpc_port=0):
+    return config_from_dict(
+        {
+            "metadata_dir": str(tmp_path / f"n{i}" / "meta"),
+            "data_dir": str(tmp_path / f"n{i}" / "data"),
+            "db_engine": "sqlite",  # crash nemesis rebuilds from disk
+            "replication_mode": "3",
+            "rpc_bind_addr": f"127.0.0.1:{rpc_port}",
+            "rpc_secret": "ab" * 32,
+            "block_size": 8192,
+            "tpu": {"enable": False},
+            "s3_api": {"api_bind_addr": None},
+        }
+    )
+
+
+async def boot_cluster(tmp_path, n=3):
+    garages = [Garage(node_config(tmp_path, i)) for i in range(n)]
+    for g in garages:
+        await g.start()
+    for i, gi in enumerate(garages):
+        for gj in garages[i + 1 :]:
+            await gj.netapp.connect(gi.netapp.bind_addr, gi.node_id)
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if all(
+            len(g.system.peering.connected_peers()) == n - 1 for g in garages
+        ):
+            break
+    lm = garages[0].layout_manager
+    for i, g in enumerate(garages):
+        lm.stage_role(g.node_id, NodeRole(zone=f"dc{i}", capacity=10**12))
+    lm.apply_staged()
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if all(g.layout_manager.digest() == lm.digest() for g in garages):
+            break
+    for g in garages:
+        g.spawn_workers()
+    key = await garages[0].helper.create_key("jepsen-key")
+    key.params().allow_create_bucket.update(True)
+    await garages[0].key_table.insert(key)
+    servers, clients = [], []
+    for g in garages:
+        s3 = S3ApiServer(g)
+        await s3.start("127.0.0.1", 0)
+        servers.append(s3)
+        port = s3.runner.addresses[0][1]
+        clients.append(
+            S3Client(f"http://127.0.0.1:{port}", key.key_id, key.secret())
+        )
+    return garages, servers, clients, key
+
+
+class History:
+    """Append-only op log; checked after the run."""
+
+    def __init__(self):
+        self.ops: list[dict] = []
+
+    def record(self, **op):
+        self.ops.append(op)
+
+    def reads(self, key):
+        return [o for o in self.ops if o["op"] == "read" and o["key"] == key
+                and o["ok"]]
+
+    def acked_writes(self, key):
+        return [o for o in self.ops if o["op"] == "write" and o["key"] == key
+                and o["ok"]]
+
+
+async def reg_writer(clients, ci, hist, key, stop):
+    """Single writer per key: versions strictly increase, so version
+    order == write order and the checkers below are exact.  Clients are
+    resolved per-op from the shared list so workers pick up the
+    replacement client after the crash/restart nemesis."""
+    ver = 0
+    while not stop.is_set():
+        ver += 1
+        t0 = time.monotonic()
+        try:
+            await clients[ci].put_object("jepsen", key, f"{ver}".encode())
+            hist.record(op="write", key=key, ver=ver, ok=True,
+                        invoke=t0, complete=time.monotonic())
+        except Exception:  # noqa: BLE001 — indeterminate, not acked
+            hist.record(op="write", key=key, ver=ver, ok=False,
+                        invoke=t0, complete=time.monotonic())
+        await asyncio.sleep(0.03)
+
+
+async def reg_reader(clients, ci, hist, key, stop):
+    while not stop.is_set():
+        t0 = time.monotonic()
+        try:
+            body = await clients[ci].get_object("jepsen", key)
+            hist.record(op="read", key=key, ver=int(body), ok=True,
+                        invoke=t0, complete=time.monotonic())
+        except Exception:  # noqa: BLE001 — read failed, no info
+            pass
+        await asyncio.sleep(0.02)
+
+
+async def set_worker(clients, ci, hist, stop):
+    """Insert a growing set of keys; delete a fraction of the acked ones."""
+    i = 0
+    while not stop.is_set():
+        k = f"set-{i:04d}"
+        t0 = time.monotonic()
+        try:
+            await clients[ci].put_object("jepsen", k, b"member")
+            hist.record(op="insert", key=k, ok=True, invoke=t0,
+                        complete=time.monotonic())
+        except Exception:  # noqa: BLE001
+            hist.record(op="insert", key=k, ok=False, invoke=t0,
+                        complete=time.monotonic())
+        if i % 5 == 3:  # delete some acked members
+            prev = f"set-{i - 2:04d}"
+            t0 = time.monotonic()
+            try:
+                await clients[ci].delete_object("jepsen", prev)
+                hist.record(op="delete", key=prev, ok=True, invoke=t0,
+                            complete=time.monotonic())
+            except Exception:  # noqa: BLE001
+                hist.record(op="delete", key=prev, ok=False, invoke=t0,
+                            complete=time.monotonic())
+        i += 1
+        await asyncio.sleep(0.03)
+
+
+async def combined_nemesis(tmp_path, garages, servers, clients, key):
+    """Partition + clock jumps + layout change + crash/restart, all in
+    one run (the reference combines nemeses the same way)."""
+    await asyncio.sleep(0.8)
+    partition(garages, [2], [0, 1])
+    await asyncio.sleep(0.8)
+    set_clock_offset(3_600_000)  # +1h
+    await asyncio.sleep(0.4)
+    heal(garages)
+
+    # layout reconfiguration under load
+    lm = garages[1].layout_manager
+    lm.stage_role(garages[0].node_id, NodeRole(zone="dc0", capacity=5 * 10**11))
+    lm.apply_staged()
+    await asyncio.sleep(0.8)
+
+    set_clock_offset(-1_800_000)  # 30min BACKWARD
+    await asyncio.sleep(0.4)
+
+    # crash node 2 and rebuild it from its on-disk state
+    await garages[2].stop()
+    await asyncio.sleep(0.8)
+    g2 = Garage(node_config(tmp_path, 2))
+    await g2.start()
+    garages[2] = g2
+    for i in (0, 1):
+        await g2.netapp.connect(garages[i].netapp.bind_addr, garages[i].node_id)
+    g2.spawn_workers()
+    s3 = S3ApiServer(g2)
+    await s3.start("127.0.0.1", 0)
+    await servers[2].stop()
+    servers[2] = s3
+    port = s3.runner.addresses[0][1]
+    old = clients[2]
+    clients[2] = S3Client(f"http://127.0.0.1:{port}", key.key_id, key.secret())
+    await old.close()
+
+    await asyncio.sleep(0.6)
+    partition(garages, [0], [1, 2])
+    await asyncio.sleep(0.8)
+    heal(garages)
+    set_clock_offset(0)
+
+
+def check_reg2(hist: History):
+    """Fails on: a read older than one that COMPLETED before it started
+    (monotonicity), or a read that misses an acked write that completed
+    before the read began (lost acked write / stale quorum)."""
+    violations = []
+    for i in range(N_REG_KEYS):
+        key = f"reg-{i}"
+        reads = sorted(hist.reads(key), key=lambda o: o["invoke"])
+        for a_idx in range(len(reads)):
+            a = reads[a_idx]
+            for b in reads[a_idx + 1 :]:
+                if a["complete"] < b["invoke"] and b["ver"] < a["ver"]:
+                    violations.append(
+                        f"{key}: read v{b['ver']} after a finished read of "
+                        f"v{a['ver']} (went backward)"
+                    )
+        floor_writes = hist.acked_writes(key)
+        for r in reads:
+            floor = max(
+                (w["ver"] for w in floor_writes if w["complete"] < r["invoke"]),
+                default=0,
+            )
+            if r["ver"] < floor:
+                violations.append(
+                    f"{key}: read v{r['ver']} after write v{floor} was acked"
+                )
+    assert not violations, "\n".join(violations[:10])
+
+
+async def check_set2(hist: History, client):
+    """Every acked insert not targeted by any delete attempt must be
+    listed; every acked delete must be absent.  (Un-acked ops are
+    indeterminate either way.)"""
+    acked_ins = {o["key"] for o in hist.ops if o["op"] == "insert" and o["ok"]}
+    tried_del = {o["key"] for o in hist.ops if o["op"] == "delete"}
+    acked_del = {o["key"] for o in hist.ops if o["op"] == "delete" and o["ok"]}
+    required = acked_ins - tried_del
+    deadline = time.monotonic() + 30
+    missing = phantom = None
+    while time.monotonic() < deadline:
+        listing = await client.list_objects_v2("jepsen", prefix="set-")
+        present = {k["key"] for k in listing["keys"]}
+        missing = required - present
+        phantom = acked_del & present
+        if not missing and not phantom:
+            return
+        await asyncio.sleep(0.5)
+    assert not missing, f"acked inserts lost: {sorted(missing)[:10]}"
+    assert not phantom, f"acked deletes resurfaced: {sorted(phantom)[:10]}"
+
+
+def test_checker_detects_violations():
+    """The history checker itself must fire on bad histories (otherwise a
+    vacuous checker would pass everything)."""
+    import pytest
+
+    # monotonicity violation: read v2 completes, later read returns v1
+    h = History()
+    h.record(op="write", key="reg-0", ver=1, ok=True, invoke=0.0, complete=0.1)
+    h.record(op="write", key="reg-0", ver=2, ok=True, invoke=0.2, complete=0.3)
+    h.record(op="read", key="reg-0", ver=2, ok=True, invoke=0.4, complete=0.5)
+    h.record(op="read", key="reg-0", ver=1, ok=True, invoke=0.6, complete=0.7)
+    with pytest.raises(AssertionError, match="went backward"):
+        check_reg2(h)
+
+    # lost acked write: write v3 acked, later read still returns v2
+    h2 = History()
+    h2.record(op="write", key="reg-1", ver=3, ok=True, invoke=0.0, complete=0.1)
+    h2.record(op="read", key="reg-1", ver=2, ok=True, invoke=0.2, complete=0.3)
+    with pytest.raises(AssertionError, match="was acked"):
+        check_reg2(h2)
+
+    # clean history passes
+    h3 = History()
+    h3.record(op="write", key="reg-2", ver=1, ok=True, invoke=0.0, complete=0.1)
+    h3.record(op="read", key="reg-2", ver=1, ok=True, invoke=0.2, complete=0.3)
+    check_reg2(h3)
+
+
+def test_jepsen_combined_nemeses(tmp_path):
+    async def main():
+        garages, servers, clients, key = await boot_cluster(tmp_path)
+        hist = History()
+        try:
+            await clients[0].create_bucket("jepsen")
+            await asyncio.sleep(0.3)
+            stop = asyncio.Event()
+            tasks = []
+            for i in range(N_REG_KEYS):
+                k = f"reg-{i}"
+                tasks.append(asyncio.create_task(
+                    reg_writer(clients, i % 3, hist, k, stop)))
+                tasks.append(asyncio.create_task(
+                    reg_reader(clients, (i + 1) % 3, hist, k, stop)))
+                tasks.append(asyncio.create_task(
+                    reg_reader(clients, (i + 2) % 3, hist, k, stop)))
+            tasks.append(asyncio.create_task(set_worker(clients, 0, hist, stop)))
+
+            nemesis = asyncio.create_task(
+                combined_nemesis(tmp_path, garages, servers, clients, key)
+            )
+            await asyncio.sleep(RUN_SECONDS)
+            await nemesis
+            stop.set()
+            await asyncio.gather(*tasks)
+
+            n_acked = sum(1 for o in hist.ops if o["ok"])
+            assert n_acked > 50, (
+                f"workloads made too little progress ({n_acked} acked ops)"
+            )
+            check_reg2(hist)
+
+            # final convergence: the last acked version of each register
+            # must be readable (retry while anti-entropy settles)
+            for i in range(N_REG_KEYS):
+                k = f"reg-{i}"
+                last = max((w["ver"] for w in hist.acked_writes(k)), default=0)
+                deadline = time.monotonic() + 30
+                got = -1
+                while time.monotonic() < deadline:
+                    try:
+                        got = int(await clients[0].get_object("jepsen", k))
+                        if got >= last:
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    await asyncio.sleep(0.5)
+                assert got >= last, f"{k}: acked v{last} lost (read v{got})"
+
+            await check_set2(hist, clients[1])
+        finally:
+            set_clock_offset(0)
+            for c in clients:
+                await c.close()
+            for s in servers:
+                await s.stop()
+            for g in garages:
+                await g.stop()
+
+    run(main())
