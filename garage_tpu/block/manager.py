@@ -652,29 +652,40 @@ class BlockManager:
                 f"block {hash32.hex()[:16]}: gathered pieces are corrupt"
             )
 
-    async def reconstruct_local_piece(self, hash32: bytes) -> bool:
-        """Rebuild THIS node's piece from surviving peers (EC resync path).
-        Returns True if a piece was stored."""
+    def ec_ranks_of(self, hash32: bytes) -> list[int]:
+        """THIS node's piece ranks across ALL active layout versions,
+        newest version first.  A node whose rank differs between versions
+        holds SEVERAL pieces while a migration is open (the write path
+        places them; resync must track and heal every one, or the
+        per-version decode guarantee silently erodes)."""
         layout = self.system.layout_manager.history
-        my_rank = None
-        # newest version first: the current rank is this node's primary
-        # piece; during a migration an old-version rank still counts (the
-        # piece remains readable there until the transition completes)
+        ranks: list[int] = []
         for v in reversed([v for v in layout.versions if v.ring_assignment]):
             nodes = v.nodes_of(hash32)
             if self.system.id in nodes[: self.codec.n_pieces]:
-                my_rank = nodes.index(self.system.id)
-                break
-        if my_rank is None:
+                r = nodes.index(self.system.id)
+                if r not in ranks:
+                    ranks.append(r)
+        return ranks
+
+    async def reconstruct_local_piece(self, hash32: bytes) -> bool:
+        """Rebuild THIS node's missing piece(s) from surviving peers (EC
+        resync path).  Returns True if any piece was stored."""
+        missing = [
+            r for r in self.ec_ranks_of(hash32)
+            if not self.find_block_file(hash32, piece=r)
+        ]
+        if not missing:
             return False
         blen, pieces = await self.gather_pieces(
             hash32, self.codec.min_pieces, prio=PRIO_BACKGROUND, exclude_self=True
         )
         self._verify_gathered(hash32, pieces, blen)
-        rec = self.codec.reconstruct_pieces(pieces, [my_rank], blen)
-        await self.write_block_local(
-            hash32, wrap_piece(blen, rec[my_rank]), False, piece=my_rank
-        )
+        rec = self.codec.reconstruct_pieces(pieces, missing, blen)
+        for r in missing:
+            await self.write_block_local(
+                hash32, wrap_piece(blen, rec[r]), False, piece=r
+            )
         return True
 
     async def bulk_reconstruct(self, hashes: list[bytes]) -> int:
@@ -683,18 +694,13 @@ class BlockManager:
         (TPU dispatch for large batches, BASELINE 10k-block resync
         target), store the results.  Blocks that cannot be gathered are
         queued for resync's retry/backoff loop.  Returns pieces rebuilt."""
-        nodes_of = self.system.layout_manager.history.current().nodes_of
         todo: list[tuple[bytes, int]] = []
         for h in hashes:
             if not self.rc.is_needed(h):
                 continue  # never resurrect deleted blocks
-            nodes = nodes_of(h)
-            if self.system.id not in nodes:
-                continue
-            my_rank = nodes.index(self.system.id)
-            if my_rank >= self.codec.n_pieces or self.find_block_file(h, piece=my_rank):
-                continue
-            todo.append((h, my_rank))
+            for r in self.ec_ranks_of(h):
+                if not self.find_block_file(h, piece=r):
+                    todo.append((h, r))
         if not todo:
             return 0
 

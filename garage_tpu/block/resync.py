@@ -57,6 +57,15 @@ class BlockResyncManager:
     def queue_len(self) -> int:
         return len(self.queue)
 
+    def due_empty(self) -> bool:
+        """True if no queue entry is due yet.  The queue is time-ordered
+        (`when|hash` keys), so this is O(1).  Future-dated entries
+        (GC-delay deletes, error backoffs) must not gate layout-sync
+        completion — under steady delete traffic the queue is never
+        LITERALLY empty and a migration would never close."""
+        f = self.queue.first()
+        return f is None or f[0][:8] > now_msec().to_bytes(8, "big")
+
     def errors_len(self) -> int:
         return len(self.errors)
 
@@ -114,23 +123,18 @@ class BlockResyncManager:
         i_store = mgr.system.id in mgr.storage_nodes_of(hash32)
 
         if mgr.codec.n_pieces > 1:
-            # EC mode: this node's unit of storage is ITS piece.  A node
-            # is a holder if it ranks < n_pieces in ANY active layout
-            # version — an old-version holder must NOT drop its piece
-            # while a migration is open (the multi-set write guarantee
-            # says either version's set alone can decode); it hands off
-            # only after trim retires the old version.
-            layout = mgr.system.layout_manager.history
-            nodes = layout.current().nodes_of(hash32)
-            my_rank = None
-            for v in reversed([v for v in layout.versions if v.ring_assignment]):
-                nodes_v = v.nodes_of(hash32)
-                if mgr.system.id in nodes_v[: mgr.codec.n_pieces]:
-                    my_rank = nodes_v.index(mgr.system.id)
-                    break
-            is_holder = my_rank is not None
+            # EC mode: this node's unit of storage is its piece(s).  A
+            # node is a holder if it ranks < n_pieces in ANY active
+            # layout version (possibly with different ranks -> several
+            # pieces) — an old-version holder must NOT drop pieces while
+            # a migration is open (the multi-set write guarantee says
+            # either version's set alone can decode); it hands off only
+            # after trim retires the old version.
+            nodes = mgr.system.layout_manager.history.current().nodes_of(hash32)
+            my_ranks = mgr.ec_ranks_of(hash32)
+            is_holder = bool(my_ranks)
             local = mgr.local_pieces(hash32)
-            if needed and is_holder and my_rank not in local:
+            if needed and is_holder and any(r not in local for r in my_ranks):
                 await mgr.reconstruct_local_piece(hash32)
                 logger.debug("resync: reconstructed piece for %s", hash32.hex()[:16])
                 return
@@ -304,7 +308,7 @@ class _LayoutSyncWorker(Worker):
                     return WorkerState.BUSY
             self._cursor = None
             return WorkerState.BUSY
-        if self.resync.queue_len() == 0 and self.resync.errors_len() == 0:
+        if self.resync.due_empty() and self.resync.errors_len() == 0:
             self.lm.component_synced("block", self._version)
             self._version = None
         return WorkerState.IDLE

@@ -1,0 +1,33 @@
+"""Metadata-plane perf regression guards (VERDICT r2 Missing #6).
+
+Thresholds are ~5-10x below the measured round-3 numbers (README "Tests &
+bench" table) so background load on the 1-CPU CI box can't flake them,
+while an accidental O(n) or pathological-fsync regression still trips.
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench_meta
+
+
+def test_db_engine_throughput_floor():
+    for engine, floor_insert, floor_get in (
+        ("sqlite", 3_000, 20_000),
+        ("log", 800, 100_000),
+    ):
+        r = bench_meta.bench_db_engine(engine, 1000)
+        assert r["insert_ops"] > floor_insert, (engine, r)
+        assert r["get_ops"] > floor_get, (engine, r)
+        assert r["tx_insert_ops"] > 10_000, (engine, r)
+        assert r["scan_keys_per_s"] > 50_000, (engine, r)
+
+
+def test_s3_metadata_path_floor():
+    r = asyncio.run(bench_meta.bench_s3_meta("sqlite", 120, 120))
+    assert r["inline_put_ops"] > 60, r
+    assert r["list_keys_per_s"] > 2_000, r
+    assert r["listed"] == 120

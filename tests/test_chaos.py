@@ -375,6 +375,11 @@ def test_layout_transition_completes_and_trims(tmp_path):
                 await clients[0].put_object(
                     "trimtest", f"k{i}", os.urandom(20_000)
                 )
+            # a delete leaves a FUTURE-dated GC entry in the resync queue
+            # (10-min delay); the transition must still close — the block
+            # sync gate counts only due work (resync.due_empty)
+            await clients[0].put_object("trimtest", "doomed", os.urandom(20_000))
+            await clients[0].delete_object("trimtest", "doomed")
             from garage_tpu.rpc.layout.types import NodeRole
 
             lm = garages[0].layout_manager
