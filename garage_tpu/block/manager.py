@@ -515,19 +515,25 @@ class BlockManager:
                 seen.setdefault(t)
         return list(seen), per_version
 
-    async def rpc_get_block(self, hash32: bytes, prio: int = PRIO_NORMAL) -> bytes:
+    async def rpc_get_block(
+        self, hash32: bytes, prio: int = PRIO_NORMAL, order_tag=None
+    ) -> bytes:
         """Fetch a block: local first, then peers in latency order with
         fallback (reference manager.rs:243-344).  EC mode gathers k pieces
-        (data-piece fast path, any-k + decode on failure)."""
+        (data-piece fast path, any-k + decode on failure).  `order_tag`
+        serializes this fetch within a multi-block GET pipeline so
+        responses stream back-to-back (reference net/message.rs:62-89)."""
         from ..utils.metrics import registry
         from ..utils.tracing import span
 
         with span("block:get"):
-            data = await self._rpc_get_block(hash32, prio)
+            data = await self._rpc_get_block(hash32, prio, order_tag)
         registry.incr("block_bytes_read", by=len(data))
         return data
 
-    async def _rpc_get_block(self, hash32: bytes, prio: int = PRIO_NORMAL) -> bytes:
+    async def _rpc_get_block(
+        self, hash32: bytes, prio: int = PRIO_NORMAL, order_tag=None
+    ) -> bytes:
         if self.codec.n_pieces == 1:
             local = await self.read_block_local(hash32)
             if local is not None:
@@ -538,7 +544,9 @@ class BlockManager:
                 if n == self.system.id:
                     continue
                 try:
-                    resp = await self.endpoint.call(n, ["Get", hash32], prio=prio)
+                    resp = await self.endpoint.call(
+                        n, ["Get", hash32], prio=prio, order_tag=order_tag
+                    )
                     declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
                     # reserve before buffering; held through decompress+verify
                     async with self.buffers.reserve(declared):
@@ -554,10 +562,10 @@ class BlockManager:
                 except Exception as e:  # noqa: BLE001
                     errors.append(f"{n.hex()[:8]}: {e!r}")
             raise Error(f"block {hash32.hex()[:16]} unavailable: {errors}")
-        return await self._ec_get(hash32, prio)
+        return await self._ec_get(hash32, prio, order_tag)
 
     async def _fetch_piece(
-        self, node: bytes, hash32: bytes, piece: int, prio
+        self, node: bytes, hash32: bytes, piece: int, prio, order_tag=None
     ) -> tuple[int, bytes]:
         """-> (block_len, piece_bytes)"""
         if node == self.system.id:
@@ -569,14 +577,17 @@ class BlockManager:
             if found[1]:
                 stored = zstandard.decompress(stored)
             return unwrap_piece(stored)
-        resp = await self.endpoint.call(node, ["Get", hash32, piece], prio=prio)
+        resp = await self.endpoint.call(
+            node, ["Get", hash32, piece], prio=prio, order_tag=order_tag
+        )
         meta, stored = await _resp_payload(resp, budget=self.buffers)
         if meta.get("c"):
             stored = zstandard.decompress(stored)
         return unwrap_piece(stored)
 
     async def gather_pieces(
-        self, hash32: bytes, want_k: int, prio=PRIO_NORMAL, exclude_self=False
+        self, hash32: bytes, want_k: int, prio=PRIO_NORMAL, exclude_self=False,
+        order_tag=None,
     ) -> tuple[int, dict[int, bytes]]:
         """Collect at least want_k distinct pieces -> (block_len, pieces).
 
@@ -596,7 +607,10 @@ class BlockManager:
             if not (exclude_self and nodes[i] == self.system.id)
         ]
         results = await asyncio.gather(
-            *[self._fetch_piece(n, hash32, i, prio) for i, n in fetches],
+            *[
+                self._fetch_piece(n, hash32, i, prio, order_tag=order_tag)
+                for i, n in fetches
+            ],
             return_exceptions=True,
         )
         for (i, n), r in zip(fetches, results):
@@ -633,11 +647,13 @@ class BlockManager:
             )
         return block_len, pieces
 
-    async def _ec_get(self, hash32: bytes, prio) -> bytes:
+    async def _ec_get(self, hash32: bytes, prio, order_tag=None) -> bytes:
         """Gather k pieces and decode; the plaintext block hash is verified
         after decode, so corrupted pieces are caught end-to-end."""
         k = self.codec.min_pieces
-        blen, pieces = await self.gather_pieces(hash32, k, prio)
+        blen, pieces = await self.gather_pieces(
+            hash32, k, prio, order_tag=order_tag
+        )
         data = self.codec.decode(pieces, blen)
         if blake2sum(data) != hash32:
             raise Error("EC decode does not match block hash")

@@ -29,6 +29,7 @@ overflowing the receiver's buffer.
 from __future__ import annotations
 
 import asyncio
+import heapq
 import logging
 import struct
 from typing import Any, AsyncIterator, Awaitable, Callable
@@ -67,9 +68,12 @@ class ConnectionClosed(Exception):
 class _Outgoing:
     """One message being sent: frames yielded chunk by chunk."""
 
-    __slots__ = ("frames", "rid", "aborted", "owns_credit")
+    __slots__ = ("frames", "rid", "aborted", "owns_credit", "tag", "level")
 
-    def __init__(self, frames, rid: int, owns_credit: bool = False):
+    def __init__(
+        self, frames, rid: int, owns_credit: bool = False,
+        tag: tuple | None = None, level: int = 0,
+    ):
         self.frames = frames  # async iterator of (kind, flags, id, payload)
         self.rid = rid
         self.aborted = False
@@ -77,6 +81,9 @@ class _Outgoing:
         # control frames (CREDIT grants, CANCELs) share the rid and must
         # not tear the credit down when they finish
         self.owns_credit = owns_credit
+        # order-tag key + seq for sender-side stream serialization
+        self.tag = tag  # ((mine, sid), seq) or None
+        self.level = level
 
 
 class _StreamCredit:
@@ -168,6 +175,13 @@ class Connection:
         # queues, by rid — so a peer CANCEL can abort them mid-flight
         # (they are reachable neither via _pending nor via credit.parked)
         self._active_out: dict[int, _Outgoing] = {}
+        # ordered sub-streams (reference src/net/message.rs:62-89): among
+        # same-tag messages pending at once, transmit ONE at a time in
+        # ascending seq order, so a prefetch pipeline's responses stream
+        # back-to-back instead of interleaving.  Keyed by (mine, sid) —
+        # our requests and our responses echoing the REMOTE's sids must
+        # not share a namespace.  (mine, sid) -> {"active", "waiting"}
+        self._order: dict[tuple, dict] = {}
         self._tasks: list[asyncio.Task] = []
         self._closed = False
 
@@ -204,7 +218,10 @@ class Connection:
         frames = _frames_of(
             K_REQ_META, rid, meta, _pack(req.body), req.stream, credit
         )
-        out = await self._enqueue(prio, frames, rid, owns_credit=credit is not None)
+        out = await self._enqueue(
+            prio, frames, rid, owns_credit=credit is not None,
+            order_tag=req.order_tag,
+        )
         self._pending[rid]["out"] = out
         try:
             if timeout is not None:
@@ -242,14 +259,43 @@ class Connection:
             self._send_wakeup.set()
 
     async def _enqueue(
-        self, prio: int, frames, rid: int, owns_credit: bool = False
+        self, prio: int, frames, rid: int, owns_credit: bool = False,
+        order_tag=None,
     ) -> _Outgoing:
-        out = _Outgoing(frames, rid, owns_credit=owns_credit)
+        lvl = prio_level(prio)
+        tag = None
+        if order_tag is not None:
+            tag = ((self._rid_is_mine(rid), order_tag.stream), order_tag.seq)
+        out = _Outgoing(frames, rid, owns_credit=owns_credit, tag=tag, level=lvl)
         if owns_credit:
             self._active_out[rid] = out
-        self._send_queues[prio_level(prio)].put_nowait(out)
+        if tag is not None:
+            ent = self._order.setdefault(tag[0], {"active": False, "waiting": []})
+            if ent["active"]:
+                heapq.heappush(ent["waiting"], (tag[1], rid, out))
+                return out
+            ent["active"] = True
+        self._send_queues[lvl].put_nowait(out)
         self._send_wakeup.set()
         return out
+
+    def _order_release(self, out: _Outgoing) -> None:
+        """The tagged message finished (sent fully, aborted, or errored):
+        start the smallest-seq waiter, or retire the stream state.  Never
+        waits for seqs that were never enqueued — a gap (cancelled
+        request) cannot wedge the stream."""
+        if out.tag is None:
+            return
+        out.tag, key = None, out.tag[0]  # guard double release
+        ent = self._order.get(key)
+        if ent is None:
+            return
+        if ent["waiting"]:
+            _seq, _rid, nxt = heapq.heappop(ent["waiting"])
+            self._send_queues[nxt.level].put_nowait(nxt)
+            self._send_wakeup.set()
+        else:
+            del self._order[key]
 
     async def _send_loop(self) -> None:
         try:
@@ -274,6 +320,7 @@ class Connection:
                     if out.owns_credit:
                         self._out_credit.pop(out.rid, None)
                         self._active_out.pop(out.rid, None)
+                    self._order_release(out)
                     continue
                 # send ONE chunk of this message, then rotate it to the back
                 # of its level queue (round-robin within priority)
@@ -283,6 +330,7 @@ class Connection:
                     if out.owns_credit:
                         self._out_credit.pop(out.rid, None)
                         self._active_out.pop(out.rid, None)
+                    self._order_release(out)
                     continue
                 except Exception as e:  # stream producer failed mid-message
                     logger.warning(
@@ -307,6 +355,7 @@ class Connection:
                     if out.owns_credit:
                         self._out_credit.pop(out.rid, None)
                         self._active_out.pop(out.rid, None)
+                    self._order_release(out)
                     continue
                 kind, flags, rid, payload = frame
                 if kind == K_WAIT:
@@ -321,6 +370,22 @@ class Connection:
                     struct.pack("<BBI", kind, flags, rid) + payload
                 )
                 await self.box.drain()
+                if out.tag is not None:
+                    # preemption (reference send.rs:135): if a SMALLER seq
+                    # of this ordered stream arrived while we streamed,
+                    # park this message and let the earlier one take over
+                    ent = self._order.get(out.tag[0])
+                    if (
+                        ent is not None
+                        and ent["waiting"]
+                        and ent["waiting"][0][0] < out.tag[1]
+                    ):
+                        heapq.heappush(
+                            ent["waiting"], (out.tag[1], out.rid, out)
+                        )
+                        _s, _r, nxt = heapq.heappop(ent["waiting"])
+                        self._send_queues[nxt.level].put_nowait(nxt)
+                        continue
                 self._send_queues[lvl].put_nowait(out)
         except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
             pass
@@ -468,13 +533,21 @@ class Connection:
         return on_consume
 
     async def _run_handler(self, rid: int, st: dict, req: Req) -> None:
+        from .message import OrderTag
+
         meta = st["meta"]
+        # response streams ride the request's order tag (or an explicit
+        # one the handler sets): a tagged GET prefetch pipeline's blocks
+        # transmit one at a time, in seq order
+        ot = OrderTag.from_obj(meta.get("ot"))
         try:
             resp = await self.handler(meta["ep"], self.peer_id, req)
+            if resp.order_tag is not None:
+                ot = resp.order_tag
             rmeta = {
                 "err": None,
                 "hs": resp.stream is not None,
-                "ot": resp.order_tag.to_obj() if resp.order_tag else meta.get("ot"),
+                "ot": ot.to_obj() if ot else None,
             }
             credit = None
             if resp.stream is not None:
@@ -493,6 +566,7 @@ class Connection:
         await self._enqueue(
             meta.get("prio", PRIO_NORMAL), frames, rid,
             owns_credit=rid in self._out_credit,
+            order_tag=ot,
         )
         self._incoming.pop(rid, None)
 

@@ -6,6 +6,7 @@ Reference: src/net/message.rs:49-58 (priorities), :62-89 (order tags),
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, AsyncIterator
 
 # Request priorities: lower value = more urgent.  The secondary flag lets a
@@ -58,6 +59,14 @@ class OrderTagStream:
         t = OrderTag(self.sid, self._next)
         self._next += 1
         return t
+
+
+_next_sid = itertools.count(1)
+
+
+def new_order_stream() -> OrderTagStream:
+    """Process-unique ordered sub-stream (one per GET pipeline)."""
+    return OrderTagStream(next(_next_sid))
 
 
 class Req:

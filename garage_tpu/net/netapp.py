@@ -83,6 +83,9 @@ class NetApp:
         # fault-injection seam (chaos tests): peers in this set are
         # unreachable — calls fail fast, like a network partition
         self.blocked_peers: set[bytes] = set()
+        # one-way latency (ms) added to every outgoing remote call
+        # (benchmark/chaos seam simulating inter-node RTT)
+        self.injected_latency_ms: float = 0.0
         self.on_connected: Callable[[bytes, bool], None] | None = None
         self.on_disconnected: Callable[[bytes], None] | None = None
 
@@ -194,6 +197,11 @@ class NetApp:
             return await self._dispatch(path, self.id, req)
         if target in self.blocked_peers:
             raise RpcError(f"peer {target.hex()[:16]} unreachable (partition)")
+        if self.injected_latency_ms:
+            # fault/latency-injection seam (benchmarks + chaos tests):
+            # simulate inter-node RTT like the reference's mknet-based
+            # benchmarks (doc/book/design/benchmarks: 100ms RTT runs)
+            await asyncio.sleep(self.injected_latency_ms / 1000.0)
         conn = self.conns.get(target)
         if conn is None:
             raise RpcError(f"not connected to {target.hex()[:16]}")

@@ -469,3 +469,204 @@ def test_clock_skew_nemesis_delete_and_overwrite_win(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_multidrive_add_remove_rebalance_scrub(tmp_path):
+    """Drives added/removed on a node while the cluster serves writes
+    (reference src/block/repair.rs:531- rebalance): after a drive is
+    ADDED, rebalance must land every piece in its new primary location,
+    hash-intact; after a drive is REMOVED (dead disk), resync must
+    reconstruct the lost pieces from peers and all acked objects must
+    still decode."""
+    import pathlib
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.s3.client import S3Client
+    from garage_tpu.block.manager import unwrap_piece
+    from garage_tpu.block.repair import RebalanceWorker
+    from garage_tpu.model.garage import Garage
+    from garage_tpu.rpc.layout.types import NodeRole
+    from garage_tpu.utils.background import WorkerState
+    from garage_tpu.utils.config import config_from_dict
+
+    def node_cfg(i, drives=None):
+        d = tmp_path / f"n{i}"
+        data_dir = (
+            [{"path": str(p), "capacity": "1G"} for p in drives]
+            if drives
+            else str(d / "data")
+        )
+        return config_from_dict(
+            {
+                "metadata_dir": str(d / "meta"),
+                "data_dir": data_dir,
+                "db_engine": "sqlite",
+                "replication_mode": "ec:2:1",
+                "rpc_bind_addr": "127.0.0.1:0",
+                "rpc_secret": "cd" * 32,
+                "block_size": 8192,
+                "tpu": {"enable": False},
+                "s3_api": {"api_bind_addr": None},
+            }
+        )
+
+    drives0 = [tmp_path / "n0" / f"drive{j}" for j in range(3)]
+
+    async def scrub_node0_primary(bm):
+        """Every locally held piece must sit in its primary dir and
+        verify its embedded integrity hash."""
+        bad = []
+        for key, _v in bm.rc.tree.iter_range():
+            want_base = bm.data_layout.primary_dir(key)
+            for piece, (path, compressed) in bm.local_pieces(key).items():
+                if not path.startswith(want_base):
+                    bad.append((key.hex()[:12], piece, path))
+                    continue
+                with open(path, "rb") as f:
+                    stored = f.read()
+                if compressed:
+                    import zstandard
+
+                    stored = zstandard.decompress(stored)
+                unwrap_piece(stored)  # raises on hash mismatch
+        assert not bad, f"pieces not at primary location: {bad[:5]}"
+
+    async def main():
+        garages = []
+        for i in range(3):
+            cfg = node_cfg(i, drives=drives0[:2] if i == 0 else None)
+            garages.append(Garage(cfg))
+        for g in garages:
+            await g.start()
+        for i, gi in enumerate(garages):
+            for gj in garages[i + 1 :]:
+                await gj.netapp.connect(gi.netapp.bind_addr, gi.node_id)
+        lm = garages[0].layout_manager
+        for i, g in enumerate(garages):
+            lm.stage_role(g.node_id, NodeRole(zone=f"dc{i}", capacity=10**12))
+        lm.apply_staged()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if all(g.layout_manager.digest() == lm.digest() for g in garages):
+                break
+        for g in garages:
+            g.spawn_workers()
+        key = await garages[0].helper.create_key("md-key")
+        key.params().allow_create_bucket.update(True)
+        await garages[0].key_table.insert(key)
+        servers, clients = [], []
+        for g in garages:
+            s3 = S3ApiServer(g)
+            await s3.start("127.0.0.1", 0)
+            servers.append(s3)
+            ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
+            clients.append(S3Client(ep, key.key_id, key.secret()))
+        acked = {}
+        stop_writers = asyncio.Event()
+        try:
+            await clients[0].create_bucket("mdrive")
+            await asyncio.sleep(0.3)
+
+            async def writer():
+                i = 0
+                while not stop_writers.is_set():
+                    body = os.urandom(40_000)  # 5 blocks
+                    try:
+                        await clients[1].put_object("mdrive", f"k{i:03d}", body)
+                        acked[f"k{i:03d}"] = body
+                    except Exception:  # noqa: BLE001
+                        pass
+                    i += 1
+                    await asyncio.sleep(0.02)
+
+            wt = asyncio.create_task(writer())
+            await asyncio.sleep(1.5)
+
+            # --- drive ADD on node 0, mid-write ---
+            await servers[0].stop()
+            await garages[0].stop()
+            g0 = Garage(node_cfg(0, drives=drives0))  # 3 drives now
+            await g0.start()
+            garages[0] = g0
+            for j in (1, 2):
+                await g0.netapp.connect(
+                    garages[j].netapp.bind_addr, garages[j].node_id
+                )
+            g0.spawn_workers()
+            s3 = S3ApiServer(g0)
+            await s3.start("127.0.0.1", 0)
+            servers[0] = s3
+            old = clients[0]
+            clients[0] = S3Client(
+                f"http://127.0.0.1:{s3.runner.addresses[0][1]}",
+                key.key_id, key.secret(),
+            )
+            await old.close()
+            await asyncio.sleep(1.5)
+            stop_writers.set()
+            await wt
+            assert len(acked) > 15
+
+            # rebalance to completion, then scrub: all pieces at primary
+            rb = RebalanceWorker(g0.block_manager)
+            while await rb.work() is not WorkerState.DONE:
+                pass
+            await scrub_node0_primary(g0.block_manager)
+
+            # --- drive REMOVE (dead disk) ---
+            await servers[0].stop()
+            await garages[0].stop()
+            import shutil
+
+            shutil.rmtree(drives0[1])  # the disk dies for real
+            g0 = Garage(node_cfg(0, drives=[drives0[0], drives0[2]]))
+            await g0.start()
+            garages[0] = g0
+            for j in (1, 2):
+                await g0.netapp.connect(
+                    garages[j].netapp.bind_addr, garages[j].node_id
+                )
+            g0.spawn_workers()
+            s3 = S3ApiServer(g0)
+            await s3.start("127.0.0.1", 0)
+            servers[0] = s3
+            old = clients[0]
+            clients[0] = S3Client(
+                f"http://127.0.0.1:{s3.runner.addresses[0][1]}",
+                key.key_id, key.secret(),
+            )
+            await old.close()
+
+            # resync reconstructs the lost pieces: queue everything due
+            bm = g0.block_manager
+            for k, _v in bm.rc.tree.iter_range():
+                bm.resync.queue_block(k)
+            for _ in range(2000):
+                if not await bm.resync.resync_iter():
+                    break
+            # every piece this node should hold is back, at primary
+            missing = [
+                k.hex()[:12]
+                for k, _v in bm.rc.tree.iter_range()
+                for r in bm.ec_ranks_of(k)
+                if bm.rc.is_needed(k) and not bm.find_block_file(k, piece=r)
+            ]
+            assert not missing, f"pieces not reconstructed: {missing[:5]}"
+            rb = RebalanceWorker(bm)
+            while await rb.work() is not WorkerState.DONE:
+                pass
+            await scrub_node0_primary(bm)
+
+            # and every acked object still decodes through node 0
+            for k, body in list(acked.items())[:10]:
+                assert await clients[0].get_object("mdrive", k) == body
+        finally:
+            stop_writers.set()
+            for c in clients:
+                await c.close()
+            for s in servers:
+                await s.stop()
+            for g in garages:
+                await g.stop()
+
+    run(main())

@@ -453,3 +453,159 @@ def test_stream_flow_control_backpressure():
         await b.shutdown()
 
     run(main())
+
+
+def test_ordered_substream_serializes_responses():
+    """Responses tagged with one OrderTag stream must transmit one at a
+    time in seq order (reference net/message.rs:62-89): even when the
+    seq-1 handler finishes while seq-0's stream is mid-flight, seq-0's
+    bytes all arrive before seq-1's."""
+
+    async def main():
+        from garage_tpu.net.message import OrderTag, new_order_stream
+
+        a, b = await make_node(), await make_node()
+        events = []  # (rid_label, "first"|"last") chunk arrival order
+
+        async def slow_stream(label, n_chunks):
+            async def gen():
+                for i in range(n_chunks):
+                    await asyncio.sleep(0.002)
+                    yield b"x" * 16384
+            return gen()
+
+        async def handler(from_id, req):
+            label, delay, chunks = req.body
+            await asyncio.sleep(delay)
+            return Resp(label, stream=await slow_stream(label, chunks))
+
+        b.endpoint("t/ordered").set_handler(handler)
+        await a.connect(b.bind_addr, b.id)
+
+        tags = new_order_stream()
+        t0, t1 = tags.order(), tags.order()
+
+        async def get(label, delay, chunks, tag, start_after=0.0):
+            await asyncio.sleep(start_after)
+            resp = await a.endpoint("t/ordered").call(
+                b.id, [label, delay, chunks], timeout=30, order_tag=tag
+            )
+            events.append((label, "meta"))
+            data = await read_stream_to_end(resp.stream)
+            events.append((label, "stream_done"))
+            return data
+
+        # seq 0 streams many slow chunks; seq 1 (small) is requested
+        # while seq 0 is mid-stream.  Without ordering, the round-robin
+        # scheduler would interleave and finish r1 first.
+        r0, r1 = await asyncio.gather(
+            get("r0", 0.0, 40, t0), get("r1", 0.0, 2, t1, start_after=0.02)
+        )
+        assert len(r0) == 40 * 16384 and len(r1) == 2 * 16384
+        done_order = [lab for lab, ev in events if ev == "stream_done"]
+        assert done_order == ["r0", "r1"], (
+            f"ordered sub-stream violated: {events}"
+        )
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_ordered_substream_preempts_later_seq():
+    """If seq 0 arrives while seq 1 is already mid-stream (out-of-order
+    handler completion), seq 0 must take over at the next chunk boundary
+    and finish first (reference send.rs:135 front-of-stream gating)."""
+
+    async def main():
+        from garage_tpu.net.message import new_order_stream
+
+        a, b = await make_node(), await make_node()
+        events = []
+
+        async def handler(from_id, req):
+            label, delay, chunks = req.body
+            await asyncio.sleep(delay)
+
+            async def gen():
+                for _ in range(chunks):
+                    await asyncio.sleep(0.002)
+                    yield b"y" * 16384
+
+            return Resp(label, stream=gen())
+
+        b.endpoint("t/preempt").set_handler(handler)
+        await a.connect(b.bind_addr, b.id)
+        tags = new_order_stream()
+        t0, t1 = tags.order(), tags.order()
+
+        async def get(label, delay, chunks, tag):
+            resp = await a.endpoint("t/preempt").call(
+                b.id, [label, delay, chunks], timeout=30, order_tag=tag
+            )
+            data = await read_stream_to_end(resp.stream)
+            events.append(label)
+            return data
+
+        # r1's handler is instant with a LONG stream; r0's handler takes
+        # 30ms (still well within r1's stream time) with a small stream
+        r0, r1 = await asyncio.gather(
+            get("r0", 0.03, 2, t0), get("r1", 0.0, 60, t1)
+        )
+        assert len(r0) == 2 * 16384 and len(r1) == 60 * 16384
+        assert events == ["r0", "r1"], f"no preemption: {events}"
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
+
+
+def test_ordered_substream_gap_does_not_wedge():
+    """A missing middle seq must not stall later seqs even while earlier
+    ones are STILL PENDING concurrently (the serializer orders among
+    pending messages; it never waits for seqs that were never
+    enqueued).  seq 0 streams slowly, seq 1 is never sent, seq 2 is
+    issued concurrently — seq 2 must complete, after seq 0."""
+
+    async def main():
+        from garage_tpu.net.message import new_order_stream
+
+        a, b = await make_node(), await make_node()
+
+        async def handler(from_id, req):
+            if req.body == "slow":
+                async def gen():
+                    for _ in range(20):
+                        await asyncio.sleep(0.002)
+                        yield b"z" * 16384
+
+                return Resp("slow", stream=gen())
+            return Resp(req.body * 2)
+
+        b.endpoint("t/gap").set_handler(handler)
+        await a.connect(b.bind_addr, b.id)
+        tags = new_order_stream()
+        t0 = tags.order()
+        _skipped = tags.order()  # seq 1 never sent
+        t2 = tags.order()
+        done = []
+
+        async def slow0():
+            r = await a.endpoint("t/gap").call(
+                b.id, "slow", timeout=30, order_tag=t0
+            )
+            await read_stream_to_end(r.stream)
+            done.append("r0")
+
+        async def quick2():
+            await asyncio.sleep(0.01)  # issued while seq 0 is mid-stream
+            r = await a.endpoint("t/gap").call(b.id, 40, timeout=10, order_tag=t2)
+            assert r.body == 80
+            done.append("r2")
+
+        await asyncio.wait_for(asyncio.gather(slow0(), quick2()), timeout=15)
+        assert done == ["r0", "r2"], f"gap mis-ordered or wedged: {done}"
+        await a.shutdown()
+        await b.shutdown()
+
+    run(main())
