@@ -215,7 +215,9 @@ def main() -> None:
         if "--batch" not in " ".join(argv):
             cpu_argv += ["--batch", "8"]
         if "--iters" not in " ".join(argv):
-            cpu_argv += ["--iters", "5"]
+            # long enough that scheduler noise on the 1-CPU box doesn't
+            # dominate (5 iters = ~80 ms of work; 40 = ~1.5 s)
+            cpu_argv += ["--iters", "40"]
         print("# default backend unusable; falling back to cpu", file=sys.stderr)
         result = run_child(cpu_argv, env, CPU_TIMEOUT)
 

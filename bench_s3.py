@@ -27,16 +27,12 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
 
-async def run_cluster(tmp_path, mode: str, n_objects: int, size: int) -> dict:
-    from test_ec_cluster import make_ec_cluster, stop_cluster
+async def boot_bench_cluster(tmp_path, mode: str):
+    """3-node cluster + S3 server on node0 + an authorized client."""
+    from test_ec_cluster import make_ec_cluster
 
     from garage_tpu.api.s3.api_server import S3ApiServer
     from garage_tpu.api.s3.client import S3Client
-    from garage_tpu.utils import metrics as metrics_mod
-
-    # fresh registry per cluster so histograms don't mix
-    registry = metrics_mod.Metrics()
-    metrics_mod.registry = registry
 
     garages = await make_ec_cluster(tmp_path, n=3, mode=mode, block_size=65536)
     s3 = S3ApiServer(garages[0])
@@ -46,6 +42,19 @@ async def run_cluster(tmp_path, mode: str, n_objects: int, size: int) -> dict:
     key.params().allow_create_bucket.update(True)
     await garages[0].key_table.insert(key)
     client = S3Client(ep, key.key_id, key.secret())
+    return garages, s3, client
+
+
+async def run_cluster(tmp_path, mode: str, n_objects: int, size: int) -> dict:
+    from test_ec_cluster import stop_cluster
+
+    from garage_tpu.utils import metrics as metrics_mod
+
+    # fresh registry per cluster so histograms don't mix
+    registry = metrics_mod.Metrics()
+    metrics_mod.registry = registry
+
+    garages, s3, client = await boot_bench_cluster(tmp_path, mode)
     try:
         await client.create_bucket("bench")
         body = os.urandom(size)
@@ -76,23 +85,14 @@ async def run_bigget(tmp_path, size: int, depths: list[int]) -> dict:
     block).  Depth 1 reproduces the old one-ahead pipeline."""
     import time
 
-    from test_ec_cluster import make_ec_cluster, stop_cluster
+    from test_ec_cluster import stop_cluster
 
     from garage_tpu.api.s3 import objects as objects_mod
-    from garage_tpu.api.s3.api_server import S3ApiServer
-    from garage_tpu.api.s3.client import S3Client
 
     # replication "1": each block lives on exactly one node, so ~2/3 of
     # the fetches are REAL network round-trips from the serving node —
     # with "3" every block is local and there is nothing to pipeline
-    garages = await make_ec_cluster(tmp_path, n=3, mode="1", block_size=65536)
-    s3 = S3ApiServer(garages[0])
-    await s3.start("127.0.0.1", 0)
-    ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
-    key = await garages[0].helper.create_key("bench")
-    key.params().allow_create_bucket.update(True)
-    await garages[0].key_table.insert(key)
-    client = S3Client(ep, key.key_id, key.secret())
+    garages, s3, client = await boot_bench_cluster(tmp_path, "1")
     old_depth = objects_mod.GET_PREFETCH_DEPTH
     try:
         await client.create_bucket("bench")

@@ -463,20 +463,23 @@ async def plain_block_stream(garage, blocks, start: int, end: int, enc_params):
                 )
                 nxt += 1
             data = await tasks[i]
+            tasks[i] = None  # drop the result: window RAM stays bounded
             if enc_params is not None:
                 data = enc_params.decrypt_block(data)
             lo = max(start - b_start, 0)
             hi = min(end, b_end) - b_start
             yield data[lo:hi]
+            del data
     finally:
         # consumer gone (disconnect) or error: abort every in-flight
         # prefetch, including the one currently awaited
-        pending = [t for t in tasks if not t.done()]
+        live = [t for t in tasks if t is not None]
+        pending = [t for t in live if not t.done()]
         for t in pending:
             t.cancel()
         if pending:
             await asyncio.gather(*pending, return_exceptions=True)
-        for t in tasks:  # silence never-retrieved warnings on teardown
+        for t in live:  # silence never-retrieved warnings on teardown
             if t.done() and not t.cancelled():
                 t.exception()
 
