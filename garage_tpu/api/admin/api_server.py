@@ -79,6 +79,11 @@ class AdminApiServer:
                 return self._metrics()
             if not self._check_token(request, self.admin_token):
                 return web.Response(status=403, text="forbidden")
+            if path.startswith("/v0/"):
+                # legacy v0 admin router: same operations, same handlers
+                # (reference router_v0.rs delegates to the v1 handlers
+                # the same way)
+                path = "/v1/" + path[len("/v0/"):]
             return await self._v1(request, path)
         except Exception as e:  # noqa: BLE001
             logger.exception("admin api error")

@@ -150,6 +150,11 @@ class S3ApiServer:
 
         for sub in UNIMPLEMENTED_SUBRESOURCES:
             if sub in request.query:
+                # sole implemented carve-out: bucket-level GET ?versioning
+                # (reference implements exactly GetBucketVersioning and
+                # 501s every other versioning/tagging/acl operation)
+                if sub == "versioning" and method == "GET" and not key:
+                    continue
                 raise NotImplementedError_(f"subresource {sub!r} not implemented")
 
         if not bucket_name:
@@ -192,10 +197,15 @@ class S3ApiServer:
                         self.garage, bucket_id, bucket_name, request
                     )
                 if "location" in q:
-                    from .xml_util import xml_doc
-
                     return web.Response(
                         text=xml_doc("LocationConstraint", [("", self.region)]),
+                        content_type="application/xml",
+                    )
+                if "versioning" in q:
+                    # buckets are unversioned: empty configuration, like
+                    # the reference (src/api/s3/bucket.rs:34-45)
+                    return web.Response(
+                        text=xml_doc("VersioningConfiguration", []),
                         content_type="application/xml",
                     )
                 if q.get("list-type") == "2":

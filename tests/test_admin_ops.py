@@ -208,6 +208,11 @@ def test_admin_api_bucket_key_crud(tmp_path):
         hdr = {"Authorization": "Bearer tok"}
         try:
             async with aiohttp.ClientSession(headers=hdr) as sess:
+                # legacy v0 router aliases the same operations
+                # (reference router_v0.rs)
+                async with sess.get(base + "/v0/status") as r:
+                    assert r.status == 200
+                    assert (await r.json())["node"]
                 # create key, then a bucket wired to it
                 async with sess.post(base + "/v1/key", json={"name": "ops"}) as r:
                     key = await r.json()

@@ -1526,3 +1526,42 @@ def test_lifecycle_worker_expires_and_aborts(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_get_bucket_versioning_unversioned(tmp_path):
+    """GET ?versioning returns an empty VersioningConfiguration (buckets
+    are unversioned — reference src/api/s3/bucket.rs:34-45); PUT stays
+    NotImplemented, like the reference."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("vers")
+            st, _h, data = await client._req(
+                "GET", "/vers", query=[("versioning", "")]
+            )
+            assert st == 200
+            assert b"VersioningConfiguration" in data
+            assert b"Enabled" not in data and b"Suspended" not in data
+            st, _h, data = await client._req(
+                "PUT", "/vers", query=[("versioning", "")], body=b"<x/>"
+            )
+            assert st == 501, data
+            # DELETE ?versioning must 501, NOT delete the bucket; and
+            # object-level ?versioning stays 501 too
+            st, _h, data = await client._req(
+                "DELETE", "/vers", query=[("versioning", "")]
+            )
+            assert st == 501, data
+            st, _h, data = await client._req(
+                "GET", "/vers/some-key", query=[("versioning", "")]
+            )
+            assert st == 501, data
+            await client.put_object("vers", "alive", b"still here")
+            assert await client.get_object("vers", "alive") == b"still here"
+            await client.close()
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
