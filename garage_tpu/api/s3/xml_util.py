@@ -22,7 +22,11 @@ def _render(children) -> str:
         if item is None:
             continue
         name, value = item
-        if isinstance(value, list):
+        if name == "":
+            # bare text content of the parent element (e.g. the region in
+            # <LocationConstraint>garage</LocationConstraint>)
+            out.append(escape(str(value)))
+        elif isinstance(value, list):
             out.append(f"<{name}>{_render(value)}</{name}>")
         elif isinstance(value, bool):
             out.append(f"<{name}>{'true' if value else 'false'}</{name}>")

@@ -1565,3 +1565,28 @@ def test_get_bucket_versioning_unversioned(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_get_bucket_location_valid_xml(tmp_path):
+    """GET ?location must be parseable XML with the region as the root
+    element's text (a '<>' empty-named child is what a naive renderer
+    produces — regression guard)."""
+    import xml.etree.ElementTree as ET
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("locb")
+            st, _h, data = await client._req(
+                "GET", "/locb", query=[("location", "")]
+            )
+            assert st == 200
+            root = ET.fromstring(data.decode())  # must parse
+            assert root.tag.endswith("LocationConstraint")
+            assert root.text == "garage"
+            await client.close()
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
