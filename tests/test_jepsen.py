@@ -324,7 +324,8 @@ def test_jepsen_combined_nemeses(tmp_path):
             await asyncio.gather(*tasks)
 
             n_acked = sum(1 for o in hist.ops if o["ok"])
-            assert n_acked > 50, (
+            # generous floor: the suite may share one CPU with other runs
+            assert n_acked > 25, (
                 f"workloads made too little progress ({n_acked} acked ops)"
             )
             check_reg2(hist)

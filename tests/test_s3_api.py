@@ -1590,3 +1590,44 @@ def test_get_bucket_location_valid_xml(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_concurrent_big_gets_tiny_ram_budget(tmp_path):
+    """Several concurrent multi-block GETs under a block RAM budget
+    smaller than one prefetch window must all complete (no circular
+    wait on the shared ByteBudget — the prefetch window must never hold
+    budget reservations while parked).  Needs a multi-node cluster with
+    single-copy placement: remote block fetches are what reserve from
+    the budget (local reads don't touch it)."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_chaos import make_cluster_with_clients
+    from test_ec_cluster import stop_cluster
+
+    async def main():
+        garages, servers, clients = await make_cluster_with_clients(
+            tmp_path, n=3, mode="1"
+        )
+        # shrink the SERVING node's shared budget below one prefetch window
+        from garage_tpu.block.manager import ByteBudget
+
+        garages[0].block_manager.buffers = ByteBudget(2 * 8192)
+        try:
+            await clients[0].create_bucket("budget")
+            bodies = [os.urandom(80_000) for _ in range(4)]  # ~10 blocks each
+            for i, b in enumerate(bodies):
+                await clients[0].put_object("budget", f"o{i}", b)
+
+            async def get_one(i):
+                return await clients[0].get_object("budget", f"o{i}")
+
+            got = await asyncio.wait_for(
+                asyncio.gather(*[get_one(i) for i in range(4)]), timeout=60
+            )
+            assert [len(g) for g in got] == [80_000] * 4
+            assert all(g == b for g, b in zip(got, bodies))
+        finally:
+            await stop_cluster(garages, servers, clients)
+
+    run(main())
