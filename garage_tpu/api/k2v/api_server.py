@@ -59,8 +59,13 @@ class K2VApiServer:
         return k.secret()
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
+        from ...utils.metrics import request_metrics
+
         try:
-            return await self._handle(request)
+            with request_metrics(
+                "api_k2v", request.method, "api:k2v", path=request.path
+            ):
+                return await self._handle(request)
         except ApiError as e:
             return web.Response(
                 status=e.status,

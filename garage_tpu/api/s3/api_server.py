@@ -89,16 +89,13 @@ class S3ApiServer:
         return k.secret()
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
-        from ...utils.metrics import registry
-        from ...utils.tracing import span
+        from ...utils.metrics import request_metrics
 
-        registry.incr("api_s3_request_counter", (("method", request.method),))
         try:
-            with span("api:s3", method=request.method, path=request.path):
-                with registry.timer(
-                    "api_s3_request_duration", (("method", request.method),)
-                ):
-                    return await self._handle(request)
+            with request_metrics(
+                "api_s3", request.method, "api:s3", path=request.path
+            ):
+                return await self._handle(request)
         except ApiError as e:
             if e.status == 304:
                 return web.Response(status=304)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import time
 from collections import defaultdict
+from contextlib import contextmanager as _contextmanager
 
 # 0.25 ms .. 8192 ms, log2-spaced (16 finite buckets)
 BUCKETS = [0.00025 * (2 ** i) for i in range(16)]
@@ -123,3 +124,18 @@ class _Timer:
 
 # the process-wide registry (one storage daemon per process)
 registry = Metrics()
+
+
+@_contextmanager
+def request_metrics(prefix: str, method: str, span_name: str, **span_attrs):
+    """Shared HTTP-frontend instrumentation: `<prefix>_request_counter`,
+    `<prefix>_request_duration` histogram, and a root tracing span that
+    parents the request's table/block sub-spans.  Used by the s3, k2v
+    and web servers so the pattern can't drift between them."""
+    from .tracing import span
+
+    lbl = (("method", method),)
+    registry.incr(f"{prefix}_request_counter", lbl)
+    with span(span_name, method=method, **span_attrs):
+        with registry.timer(f"{prefix}_request_duration", lbl):
+            yield

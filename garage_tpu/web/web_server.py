@@ -47,8 +47,13 @@ class WebServer:
         return host  # a global alias can be a bare domain name
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
+        from ..utils.metrics import request_metrics
+
         try:
-            return await self._serve(request)
+            with request_metrics(
+                "web", request.method, "web", host=self._bucket_name(request)
+            ):
+                return await self._serve(request)
         except (ApiError, Error) as e:
             status = getattr(e, "status", 404)
             return web.Response(status=status if status != 403 else 404, text=str(e))

@@ -107,6 +107,17 @@ def test_k2v_item_lifecycle(tmp_path):
             await client.delete_item("room1", "msg1", tok3)
             with pytest.raises(K2VError):
                 await client.read_item("room1", "msg1")
+
+            # per-method K2V api metrics were recorded (monitoring.md
+            # api_k2v_* families)
+            from garage_tpu.utils.metrics import registry
+
+            assert registry.counters[
+                ("api_k2v_request_counter", (("method", "PUT"),))
+            ] >= 2
+            assert registry.durations[
+                ("api_k2v_request_duration", (("method", "GET"),))
+            ][0] >= 2
         finally:
             await client.close()
             await k2v.stop()

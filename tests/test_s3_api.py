@@ -912,6 +912,13 @@ def test_bucket_config_and_website(tmp_path):
             )
             await client.put_bucket_config("site", "lifecycle", lcfg)
             assert b"tmp/" in await client.get_bucket_config("site", "lifecycle")
+
+            # web request metrics recorded (monitoring.md web_* families)
+            from garage_tpu.utils.metrics import registry
+
+            assert registry.counters[
+                ("web_request_counter", (("method", "GET"),))
+            ] >= 1
         finally:
             await web_srv.stop()
             await teardown(garage, s3)
