@@ -43,13 +43,13 @@ def run(coro):
     return asyncio.run(coro)
 
 
-def node_config(tmp_path, i, rpc_port=0):
+def node_config(tmp_path, i, rpc_port=0, mode="3"):
     return config_from_dict(
         {
             "metadata_dir": str(tmp_path / f"n{i}" / "meta"),
             "data_dir": str(tmp_path / f"n{i}" / "data"),
             "db_engine": "sqlite",  # crash nemesis rebuilds from disk
-            "replication_mode": "3",
+            "replication_mode": mode,
             "rpc_bind_addr": f"127.0.0.1:{rpc_port}",
             "rpc_secret": "ab" * 32,
             "block_size": 8192,
@@ -59,8 +59,8 @@ def node_config(tmp_path, i, rpc_port=0):
     )
 
 
-async def boot_cluster(tmp_path, n=3):
-    garages = [Garage(node_config(tmp_path, i)) for i in range(n)]
+async def boot_cluster(tmp_path, n=3, mode="3"):
+    garages = [Garage(node_config(tmp_path, i, mode=mode)) for i in range(n)]
     for g in garages:
         await g.start()
     for i, gi in enumerate(garages):
@@ -125,7 +125,10 @@ async def reg_writer(clients, ci, hist, key, stop):
         ver += 1
         t0 = time.monotonic()
         try:
-            await clients[ci].put_object("jepsen", key, f"{ver}".encode())
+            # bodies exceed INLINE_THRESHOLD (3072) so every write goes
+            # through the real block store (EC-coded in the ec:2:1 run)
+            body = f"{ver}:".encode() + b"x" * 4000
+            await clients[ci].put_object("jepsen", key, body)
             hist.record(op="write", key=key, ver=ver, ok=True,
                         invoke=t0, complete=time.monotonic())
         except Exception:  # noqa: BLE001 — indeterminate, not acked
@@ -139,8 +142,8 @@ async def reg_reader(clients, ci, hist, key, stop):
         t0 = time.monotonic()
         try:
             body = await clients[ci].get_object("jepsen", key)
-            hist.record(op="read", key=key, ver=int(body), ok=True,
-                        invoke=t0, complete=time.monotonic())
+            hist.record(op="read", key=key, ver=int(body.split(b":")[0]),
+                        ok=True, invoke=t0, complete=time.monotonic())
         except Exception:  # noqa: BLE001 — read failed, no info
             pass
         await asyncio.sleep(0.02)
@@ -153,7 +156,7 @@ async def set_worker(clients, ci, hist, stop):
         k = f"set-{i:04d}"
         t0 = time.monotonic()
         try:
-            await clients[ci].put_object("jepsen", k, b"member")
+            await clients[ci].put_object("jepsen", k, b"member" + b"y" * 4000)
             hist.record(op="insert", key=k, ok=True, invoke=t0,
                         complete=time.monotonic())
         except Exception:  # noqa: BLE001
@@ -173,7 +176,7 @@ async def set_worker(clients, ci, hist, stop):
         await asyncio.sleep(0.03)
 
 
-async def combined_nemesis(tmp_path, garages, servers, clients, key):
+async def combined_nemesis(tmp_path, garages, servers, clients, key, mode="3"):
     """Partition + clock jumps + layout change + crash/restart, all in
     one run (the reference combines nemeses the same way)."""
     await asyncio.sleep(0.8)
@@ -195,7 +198,7 @@ async def combined_nemesis(tmp_path, garages, servers, clients, key):
     # crash node 2 and rebuild it from its on-disk state
     await garages[2].stop()
     await asyncio.sleep(0.8)
-    g2 = Garage(node_config(tmp_path, 2))
+    g2 = Garage(node_config(tmp_path, 2, mode=mode))
     await g2.start()
     garages[2] = g2
     for i in (0, 1):
@@ -297,8 +300,20 @@ def test_checker_detects_violations():
 
 
 def test_jepsen_combined_nemeses(tmp_path):
+    _run_jepsen(tmp_path, "3")
+
+
+def test_jepsen_combined_nemeses_ec(tmp_path):
+    """Same combined-nemesis run over the erasure-coded block store:
+    during the crash window EC(2,1) writes cannot ack (all 3 pieces
+    required), but nothing acked may be lost and reads must stay
+    monotonic."""
+    _run_jepsen(tmp_path, "ec:2:1")
+
+
+def _run_jepsen(tmp_path, mode):
     async def main():
-        garages, servers, clients, key = await boot_cluster(tmp_path)
+        garages, servers, clients, key = await boot_cluster(tmp_path, mode=mode)
         hist = History()
         try:
             await clients[0].create_bucket("jepsen")
@@ -316,7 +331,9 @@ def test_jepsen_combined_nemeses(tmp_path):
             tasks.append(asyncio.create_task(set_worker(clients, 0, hist, stop)))
 
             nemesis = asyncio.create_task(
-                combined_nemesis(tmp_path, garages, servers, clients, key)
+                combined_nemesis(
+                    tmp_path, garages, servers, clients, key, mode=mode
+                )
             )
             await asyncio.sleep(RUN_SECONDS)
             await nemesis
@@ -339,7 +356,8 @@ def test_jepsen_combined_nemeses(tmp_path):
                 got = -1
                 while time.monotonic() < deadline:
                     try:
-                        got = int(await clients[0].get_object("jepsen", k))
+                        raw = await clients[0].get_object("jepsen", k)
+                        got = int(raw.split(b":")[0])
                         if got >= last:
                             break
                     except Exception:  # noqa: BLE001
