@@ -206,31 +206,36 @@ def test_layout_change_under_load(tmp_path):
     run(main())
 
 
-async def _open_disjoint_migration(tmp_path):
-    """6-node EC(2,1) cluster: initial layout on {0,1,2}; a staged+applied
-    change moves ALL capacity to {3,4,5}.  Workers are not spawned, so the
-    migration stays open (two active layout versions) and EC PUTs land
-    mid-transition.  Key + bucket are created AFTER the migration opens,
-    so their table entries span both node sets (try_write_many_sets) and
-    survive either set's death."""
+async def _open_migration(
+    tmp_path, n, assign, remove, add, bucket="ecmig"
+):
+    """EC(2,1) cluster with the initial layout on `assign`; a
+    staged+applied change removes `remove` and adds `add`.  Workers are
+    not spawned, so the migration stays open (two active layout
+    versions) and EC PUTs land mid-transition.  Key + bucket are created
+    AFTER the migration opens, so their table entries span both node
+    sets (try_write_many_sets) and survive either set's death."""
     from garage_tpu.api.s3.api_server import S3ApiServer
     from garage_tpu.api.s3.client import S3Client
     from garage_tpu.rpc.layout.types import NodeRole
 
     garages = await make_ec_cluster(
-        tmp_path, n=6, mode="ec:2:1", assign=[0, 1, 2], spawn=False
+        tmp_path, n=n, mode="ec:2:1", assign=assign, spawn=False
     )
     lm = garages[0].layout_manager
-    for i in (0, 1, 2):
+    for i in remove:
         lm.stage_role(garages[i].node_id, None)
-    for i in (3, 4, 5):
+    for i in add:
         lm.stage_role(garages[i].node_id, NodeRole(zone=f"dc{i}", capacity=10**12))
     lm.apply_staged()
-    deadline = asyncio.get_event_loop().time() + 10
+    deadline = asyncio.get_event_loop().time() + 20
     while asyncio.get_event_loop().time() < deadline:
         if all(g.layout_manager.digest() == lm.digest() for g in garages):
             break
         await asyncio.sleep(0.05)
+    assert all(
+        g.layout_manager.digest() == lm.digest() for g in garages
+    ), "layout did not propagate to every node"
     active = [v for v in lm.history.versions if v.ring_assignment]
     assert len(active) == 2, "migration should be open (two active versions)"
 
@@ -244,9 +249,16 @@ async def _open_disjoint_migration(tmp_path):
         servers.append(s3)
         ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
         clients.append(S3Client(ep, key.key_id, key.secret()))
-    await clients[0].create_bucket("ecmig")
+    await clients[0].create_bucket(bucket)
     await asyncio.sleep(0.3)
     return garages, servers, clients
+
+
+async def _open_disjoint_migration(tmp_path):
+    """6 nodes: {0,1,2} -> {3,4,5}, fully disjoint sets."""
+    return await _open_migration(
+        tmp_path, n=6, assign=[0, 1, 2], remove=[0, 1, 2], add=[3, 4, 5]
+    )
 
 
 def test_ec_put_mid_migration_survives_new_set_death(tmp_path):
@@ -668,5 +680,57 @@ def test_multidrive_add_remove_rebalance_scrub(tmp_path):
                 await s.stop()
             for g in garages:
                 await g.stop()
+
+    run(main())
+
+
+def test_multi_rank_holder_reconstructs_all_pieces(tmp_path):
+    """While a migration is open, a node whose rank DIFFERS between the
+    active layout versions holds several pieces of the same block
+    (_ec_piece_targets sends them; ec_ranks_of must report them).  If
+    that node loses its disk, reconstruction must rebuild EVERY rank it
+    owns, not just the newest version's."""
+
+    async def main():
+        # 4-node EC(2,1): v1 on {0,1,2}; v2 moves 0's capacity to 3 —
+        # nodes 1,2 stay and get new ranks for many hashes
+        garages, servers, clients = await _open_migration(
+            tmp_path, n=4, assign=[0, 1, 2], remove=[0], add=[3],
+            bucket="mrank",
+        )
+        try:
+            for i in range(12):
+                await clients[1].put_object("mrank", f"o{i}", os.urandom(20_000))
+
+            # find a (node, block) where the node owns TWO ranks
+            found = None
+            for g in garages[1:3]:
+                bm = g.block_manager
+                for h, _v in bm.rc.tree.iter_range():
+                    ranks = bm.ec_ranks_of(h)
+                    if len(ranks) >= 2:
+                        found = (g, h, ranks)
+                        break
+                if found:
+                    break
+            assert found, "no multi-rank holder found across 12 objects"
+            g, h, ranks = found
+            bm = g.block_manager
+            # the write path must already have stored every owned rank
+            for r in ranks:
+                assert bm.find_block_file(h, piece=r), (
+                    f"rank {r} piece missing after multi-version PUT"
+                )
+            # disk loss: remove ALL local pieces, then reconstruct
+            for _pi, (path, _c) in bm.local_pieces(h).items():
+                os.remove(path)
+            assert not bm.local_pieces(h)
+            assert await bm.reconstruct_local_piece(h)
+            for r in ranks:
+                assert bm.find_block_file(h, piece=r), (
+                    f"rank {r} not rebuilt by reconstruct_local_piece"
+                )
+        finally:
+            await stop_cluster(garages, servers, clients)
 
     run(main())
