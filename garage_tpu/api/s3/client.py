@@ -93,9 +93,14 @@ class S3Client:
         return [e.text for e in root.findall(".//s3:Bucket/s3:Name", ns)]
 
     async def put_object(
-        self, bucket: str, key: str, body: bytes, content_type: str | None = None
+        self, bucket: str, key: str, body: bytes,
+        content_type: str | None = None,
+        metadata: dict[str, str] | None = None,
     ) -> str:
+        """`metadata` entries become x-amz-meta-* user metadata."""
         headers = {"content-type": content_type} if content_type else {}
+        for k, v in (metadata or {}).items():
+            headers[f"x-amz-meta-{k}"] = v
         st, h, data = await self._req("PUT", f"/{bucket}/{key}", body=body, headers=headers)
         self._check(st, data)
         return h.get("ETag", "").strip('"')
@@ -249,8 +254,13 @@ class S3Client:
 
     # --- multipart ------------------------------------------------------------
 
-    async def create_multipart_upload(self, bucket: str, key: str) -> str:
-        st, _h, data = await self._req("POST", f"/{bucket}/{key}", query=[("uploads", "")])
+    async def create_multipart_upload(
+        self, bucket: str, key: str, metadata: dict[str, str] | None = None
+    ) -> str:
+        headers = {f"x-amz-meta-{k}": v for k, v in (metadata or {}).items()}
+        st, _h, data = await self._req(
+            "POST", f"/{bucket}/{key}", query=[("uploads", "")], headers=headers
+        )
         self._check(st, data)
         root = ET.fromstring(data.decode())
         ns = {"s3": "http://s3.amazonaws.com/doc/2006-03-01/"}
@@ -309,11 +319,17 @@ class S3Client:
             for p in root.findall("s3:Part", ns)
         ]
 
-    async def copy_object(self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str):
+    async def copy_object(
+        self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str,
+        headers: dict[str, str] | None = None,
+    ):
         st, _h, data = await self._req(
             "PUT",
             f"/{dst_bucket}/{dst_key}",
-            headers={"x-amz-copy-source": f"/{src_bucket}/{src_key}"},
+            headers={
+                "x-amz-copy-source": f"/{src_bucket}/{src_key}",
+                **(headers or {}),
+            },
         )
         self._check(st, data)
 

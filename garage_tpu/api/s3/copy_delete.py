@@ -53,6 +53,20 @@ async def handle_copy_object(garage, helper, api_key, dest_bucket_id, dest_key, 
 
     sv = await resolve_copy_source(garage, helper, api_key, request)
     meta = dict(sv.data.get("meta", {}))
+    # x-amz-metadata-directive: REPLACE takes the new metadata from this
+    # request instead of copying the source's (reference copy.rs:84-89);
+    # etag/size stay with the (unchanged) content.  Unknown directive
+    # values are rejected, not silently treated as COPY.
+    directive = request.headers.get("x-amz-metadata-directive", "COPY").upper()
+    if directive not in ("COPY", "REPLACE"):
+        raise BadRequest(
+            f"invalid x-amz-metadata-directive {directive!r}",
+            code="InvalidArgument",
+        )
+    if directive == "REPLACE":
+        from .objects import extract_meta_headers
+
+        meta["headers"] = extract_meta_headers(request)
     dest_existing = await garage.object_table.get(dest_bucket_id, dest_key.encode())
     ts = next_timestamp(dest_existing)
     new_uuid = gen_uuid()

@@ -23,7 +23,7 @@ from ...model.s3.version_table import Version
 from ...utils.data import blake2sum, gen_uuid
 from ...utils.time_util import now_msec
 from ..common.error import ApiError, BadRequest, NoSuchKey, NoSuchUpload
-from .objects import PUT_BLOCKS_MAX_PARALLEL, SAVED_HEADERS, _check_sha256
+from .objects import PUT_BLOCKS_MAX_PARALLEL, _check_sha256, extract_meta_headers
 from .xml_util import xml_doc
 
 
@@ -34,15 +34,11 @@ async def handle_create_multipart_upload(garage, bucket_id, key, request):
 
     enc = EncryptionParams.from_headers(request.headers)
     upload_id = gen_uuid()
-    headers = [
-        [h.lower(), v]
-        for h, v in request.headers.items()
-        if h.lower() in SAVED_HEADERS
-    ]
+    headers = extract_meta_headers(request)
     existing = await garage.object_table.get(bucket_id, key.encode())
     mpu = MultipartUpload(
         upload_id, bucket_id, key, timestamp=next_timestamp(existing),
-        enc=enc.meta() if enc else None,
+        enc=enc.meta() if enc else None, hdrs=headers,
     )
     await garage.mpu_table.insert(mpu)
     # an uploading object version marks the in-flight upload in listings
@@ -288,7 +284,19 @@ async def handle_complete_multipart_upload(garage, bucket_id, key, request, ctx=
     for _k, blk in final.sorted_blocks():
         await garage.block_ref_table.insert(BlockRef(blk["h"], final.uuid))
     etag = f"{etags_md5.hexdigest()}-{len(req_parts)}"
-    meta = {"size": total, "etag": etag, "headers": []}
+    # metadata captured at CreateMultipartUpload lives on the mpu row
+    # (the uploading marker version can be pruned by a concurrent
+    # complete PutObject; upgrade path: fall back to the marker for
+    # uploads created before hdrs moved here)
+    hdrs = [list(h) for h in mpu.hdrs] if mpu.hdrs else []
+    if not hdrs:
+        obj = await garage.object_table.get(bucket_id, key.encode())
+        if obj is not None:
+            for v in obj.versions:
+                if bytes(v.uuid) == bytes(mpu.upload_id):
+                    hdrs = [list(h) for h in v.data.get("hdrs", [])]
+                    break
+    meta = {"size": total, "etag": etag, "headers": hdrs}
     if mpu.enc is not None:
         meta["enc"] = mpu.enc
     ov = ObjectVersion(

@@ -49,6 +49,29 @@ SAVED_HEADERS = [
 ]
 
 
+def extract_meta_headers(request) -> list[list[str]]:
+    """Object metadata persisted with a version: the standard
+    SAVED_HEADERS plus every x-amz-meta-* user-metadata header
+    (reference put.rs:668-677).  aws-chunked is transport framing, not
+    object metadata — the stored body is the decoded plaintext."""
+    headers = [
+        [h, request.headers[h_orig]]
+        for h in SAVED_HEADERS
+        for h_orig in [next((k for k in request.headers if k.lower() == h), None)]
+        if h_orig
+    ]
+    headers = [
+        [h, ",".join(t for t in v.split(",") if t.strip() != "aws-chunked")]
+        for h, v in headers
+        if not (h == "content-encoding" and v.strip() == "aws-chunked")
+    ]
+    for k, v in request.headers.items():
+        kl = k.lower()
+        if kl.startswith("x-amz-meta-"):
+            headers.append([kl, v])
+    return headers
+
+
 async def _read_at_least(body, n: int) -> bytes:
     """Read until >= n bytes or EOF (StreamReader.read(n) may return any
     currently-buffered amount — trusting one read truncates uploads)."""
@@ -181,19 +204,7 @@ async def handle_put_object(
 
     enc = EncryptionParams.from_headers(request.headers)
     cks = ChecksumRequest.from_headers(request.headers)
-    headers = [
-        [h, request.headers[h_orig]]
-        for h in SAVED_HEADERS
-        for h_orig in [next((k for k in request.headers if k.lower() == h), None)]
-        if h_orig
-    ]
-    # aws-chunked is transport framing, not object metadata: the stored
-    # body is the decoded plaintext
-    headers = [
-        [h, ",".join(t for t in v.split(",") if t.strip() != "aws-chunked")]
-        for h, v in headers
-        if not (h == "content-encoding" and v.strip() == "aws-chunked")
-    ]
+    headers = extract_meta_headers(request)
     body = request.content
     block_size = garage.config.block_size
     existing = await garage.object_table.get(bucket_id, key.encode())

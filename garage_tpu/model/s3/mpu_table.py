@@ -25,6 +25,7 @@ class MultipartUpload:
         parts: CrdtMap | None = None,
         deleted: Bool | None = None,
         enc: dict | None = None,
+        hdrs: list | None = None,
     ):
         self.upload_id = upload_id
         self.bucket_id = bucket_id
@@ -33,6 +34,10 @@ class MultipartUpload:
         self.parts = parts or CrdtMap()
         self.deleted = deleted or Bool(False)
         self.enc = enc  # SSE-C {"alg","md5"} fixed at CreateMultipartUpload
+        # object metadata headers fixed at CreateMultipartUpload; stored
+        # here (not only on the uploading marker version) because a
+        # concurrent complete PutObject prunes older marker versions
+        self.hdrs = hdrs
 
     def merge(self, other: "MultipartUpload") -> None:
         self.deleted.merge(other.deleted)
@@ -43,6 +48,8 @@ class MultipartUpload:
         self.timestamp = max(self.timestamp, other.timestamp) if self.timestamp else other.timestamp
         if self.enc is None:
             self.enc = other.enc
+        if self.hdrs is None:
+            self.hdrs = other.hdrs
 
     def latest_parts(self) -> dict[int, dict]:
         """part_number -> newest {"vid","etag","size"}."""
@@ -65,6 +72,7 @@ class MultipartUpload:
             self.parts.to_obj(),
             self.deleted.to_obj(),
             self.enc,
+            self.hdrs,
         ]
 
 
@@ -88,6 +96,7 @@ class MpuTable(TableSchema):
         return MultipartUpload(
             bytes(obj[0]), bytes(obj[1]), obj[2], int(obj[3]), parts,
             Bool.from_obj(obj[5]), obj[6] if len(obj) > 6 else None,
+            obj[7] if len(obj) > 7 else None,
         )
 
     def merge_entries(self, a, b):

@@ -1638,3 +1638,76 @@ def test_concurrent_big_gets_tiny_ram_budget(tmp_path):
             await stop_cluster(garages, servers, clients)
 
     run(main())
+
+
+def test_user_metadata_roundtrip_and_copy_directive(tmp_path):
+    """x-amz-meta-* user metadata persists through PUT -> HEAD/GET
+    (reference put.rs:668-677) and CopyObject honors
+    x-amz-metadata-directive: COPY (default) vs REPLACE
+    (reference copy.rs:84-89)."""
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("metab")
+            await client.put_object(
+                "metab", "obj", b"hello metadata",
+                content_type="text/plain",
+                metadata={"owner": "alice", "purpose": "testing"},
+            )
+            h = await client.head_object("metab", "obj")
+            assert h.get("X-Amz-Meta-Owner") == "alice"
+            assert h.get("X-Amz-Meta-Purpose") == "testing"
+            assert h.get("Content-Type") == "text/plain"
+
+            # default directive (COPY): metadata travels with the copy
+            await client.copy_object("metab", "obj", "metab", "copied")
+            h2 = await client.head_object("metab", "copied")
+            assert h2.get("X-Amz-Meta-Owner") == "alice"
+            assert h2.get("Content-Type") == "text/plain"
+
+            # REPLACE: metadata comes from the copy request
+            await client.copy_object(
+                "metab", "obj", "metab", "replaced",
+                headers={
+                    "x-amz-metadata-directive": "REPLACE",
+                    "x-amz-meta-owner": "bob",
+                    "content-type": "application/json",
+                },
+            )
+            h3 = await client.head_object("metab", "replaced")
+            assert h3.get("X-Amz-Meta-Owner") == "bob"
+            assert "X-Amz-Meta-Purpose" not in h3
+            assert h3.get("Content-Type") == "application/json"
+            # content itself is the source's
+            assert await client.get_object("metab", "replaced") == b"hello metadata"
+
+            # multipart uploads persist user metadata too
+            up = await client.create_multipart_upload(
+                "metab", "mp", metadata={"origin": "mpu"}
+            )
+            etag = await client.upload_part("metab", "mp", up, 1, b"p" * 6000)
+            await client.complete_multipart_upload("metab", "mp", up, [(1, etag)])
+            h4 = await client.head_object("metab", "mp")
+            assert h4.get("X-Amz-Meta-Origin") == "mpu"
+            # (a concurrent plain PUT to the same key would win LWW over
+            # the completed upload — create-upload timestamp semantics,
+            # same as the reference — so metadata robustness against
+            # marker pruning is carried by the mpu row, not tested via
+            # visibility here)
+
+            # unknown metadata directive is rejected, not silently COPY
+            import pytest as _pytest
+
+            with _pytest.raises(S3Error) as ei:
+                await client.copy_object(
+                    "metab", "obj", "metab", "bad",
+                    headers={"x-amz-metadata-directive": "REPLACED"},
+                )
+            assert ei.value.status == 400
+            await client.close()
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
