@@ -5,7 +5,10 @@ Runs the flagship fused pipeline (GF(2^8) coding of 1 MiB blocks) on the
 default JAX backend and prints ONE JSON line:
 
     {"metric": "ec83_encode_GBps", "value": N, "unit": "GB/s",
-     "vs_baseline": N / 10.0}
+     "vs_baseline": N / 10.0, "platform": "tpu"|"cpu"|"none"}
+
+("platform" records which backend produced the number: the chip, the CPU
+fallback, or "none" for the all-backends-failed sentinel line.)
 
 Baseline (BASELINE.md north star): >= 10 GB/s EC(8,3) encode+repair on one
 v5e chip.  `vs_baseline` > 1.0 means the target is beaten.
@@ -161,6 +164,7 @@ def child_main(args) -> None:
                 "value": round(gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / 10.0, 4),
+                "platform": dev.platform,
             }
         )
     )
@@ -233,6 +237,7 @@ def main() -> None:
             "value": 0.0,
             "unit": "GB/s",
             "vs_baseline": 0.0,
+            "platform": "none",
             "error": "all backends failed or timed out",
         }
     print(json.dumps(result))

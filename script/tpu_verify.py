@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""On-chip bit-exactness check for the TPU EC paths.
+
+Interpret mode (what the CPU test suite exercises, tests/test_ec.py) can
+hide Mosaic layout/tiling bugs; this runs the REAL lowering on the real
+chip and checks, against the numpy GF(2^8) LUT oracle (garage_tpu.ops.gf):
+
+  * encode for (k,m) in {(8,3), (4,2), (16,4)} x shard sizes 128 B .. 128 KiB,
+    on all three impls (pallas_int8 / pallas_bf16 / einsum) — 27 checks;
+  * reconstruction for every single-rank erasure of EC(8,3) — all 8 data
+    shards AND all 3 parity shards — plus a full 3-rank erasure — 12 checks;
+  * the fused encode+hash ScrubRepairPipeline parity output — 1 check.
+
+Run:  python script/tpu_verify.py        (needs the live TPU backend)
+Exit: 0 = every path bit-exact; 1 = any mismatch; asserts if no chip.
+
+Round-3 chip run (2026-07-29 10:29 UTC, TPU_STATUS_r03.md): the 37-check
+version of this script (data-shard erasures only) passed ALL-OK; the
+parity-shard erasure checks were added after that run (total now 40) and
+await the next healthy-tunnel window.
+"""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
+
+import jax
+import jax.numpy as jnp
+
+from garage_tpu.ops import gf
+from garage_tpu.ops.ec_tpu import ec_apply_fn
+
+dev = jax.devices()[0]
+print(f"backend={dev.platform} device={dev}", file=sys.stderr)
+assert dev.platform != "cpu", "no TPU backend; this script validates real lowering"
+
+rng = np.random.default_rng(42)
+fails = 0
+
+for (k, m) in [(8, 3), (4, 2), (16, 4)]:
+    for s in (128, 4096, 131072):
+        b = 4
+        data = rng.integers(0, 256, (b, k, s), dtype=np.uint8)
+        mat = gf.cauchy_parity_matrix(k, m)
+        bitmat = jnp.asarray(gf.bitmatrix_of(mat), jnp.uint8)
+        for impl in ("pallas_int8", "pallas_bf16", "einsum"):
+            out = np.asarray(ec_apply_fn(None, impl)(bitmat, jnp.asarray(data)))
+            ref = gf.apply_matrix(mat, data)
+            ok = np.array_equal(out, ref)
+            print(f"encode k={k} m={m} s={s} impl={impl}: {'OK' if ok else 'MISMATCH'}")
+            fails += 0 if ok else 1
+
+k, m = 8, 3
+s = 16384
+data = rng.integers(0, 256, (2, k, s), dtype=np.uint8)
+full = np.concatenate(
+    [data, gf.apply_matrix(gf.cauchy_parity_matrix(k, m), data)], axis=1
+)
+for lost_set in [[i] for i in range(k + m)] + [[0, 1, 2]]:
+    present = [i for i in range(k + m) if i not in lost_set][:k]
+    rmat = gf.reconstruction_matrix(k, m, present, lost_set)
+    bitmat = jnp.asarray(gf.bitmatrix_of(rmat), jnp.uint8)
+    surv = full[:, present, :]
+    out = np.asarray(ec_apply_fn(None, "pallas_int8")(bitmat, jnp.asarray(surv)))
+    ok = np.array_equal(out, full[:, lost_set, :])
+    print(f"repair lost={lost_set}: {'OK' if ok else 'MISMATCH'}")
+    fails += 0 if ok else 1
+
+from garage_tpu.models.pipeline import ScrubRepairPipeline  # noqa: E402
+
+k, m, s = 8, 3, 131072
+pipe = ScrubRepairPipeline(k=k, m=m, shard_bytes=s)
+data = rng.integers(0, 256, (2, k, s), dtype=np.uint8)
+p, h, st = pipe.jitted()(jnp.asarray(data))
+ok = np.array_equal(np.asarray(p), gf.apply_matrix(gf.cauchy_parity_matrix(k, m), data))
+print(f"pipeline parity: {'OK' if ok else 'MISMATCH'}")
+fails += 0 if ok else 1
+
+print("ALL-OK" if fails == 0 else f"FAILURES={fails}")
+sys.exit(1 if fails else 0)
