@@ -191,6 +191,7 @@ class BlockManager:
         codec: BlockCodec | None = None,
         data_fsync: bool = False,
         ram_buffer_max: int = 256 * 1024 * 1024,
+        disable_scrub: bool = False,
     ):
         self.system = system
         self.helper = helper
@@ -199,6 +200,7 @@ class BlockManager:
         self.codec = codec or ReplicaCodec()
         self.compression_level = compression_level
         self.data_fsync = data_fsync
+        self.disable_scrub = disable_scrub
         self.buffers = ByteBudget(ram_buffer_max)
         self.rc = BlockRc(db)
 
@@ -228,8 +230,10 @@ class BlockManager:
         self.resync.spawn_workers(bg)
         # kept as an attribute so the admin scrub controls (pause/resume/
         # cancel/tranquility) can reach the running worker
-        self.scrub_worker = ScrubWorker(self, metadata_dir=self.metadata_dir)
-        bg.spawn(self.scrub_worker)
+        self.scrub_worker = None
+        if not self.disable_scrub:  # config.rs disable_scrub / manager.rs:202
+            self.scrub_worker = ScrubWorker(self, metadata_dir=self.metadata_dir)
+            bg.spawn(self.scrub_worker)
 
     # --- placement -----------------------------------------------------------
 
@@ -442,43 +446,90 @@ class BlockManager:
         # decodable even if either version's node set dies afterwards.
         # Pieces are not compressed (parity shards don't compress; data
         # shards rarely worth it).
+        #
+        # Like the replica path, the PUT returns as soon as every active
+        # version holds its piece quorum; leftover sends finish in the
+        # background (slow nodes still get their piece — they'd otherwise
+        # heal via resync anyway).  Waiting for ALL k+m sends made the EC
+        # PUT p99 the max over k+m nodes vs the replica path's
+        # quorum-of-RF, measurably fattening the tail (bench_s3.py).
         pieces = self.codec.encode(data)
         send_targets, per_version = self._ec_piece_targets(hash32, layout)
-        async with self.buffers.reserve(
-            sum(len(pieces[i]) for _n, i in send_targets)
-        ):
-            results = await asyncio.gather(
-                *[
-                    self.endpoint.call(
-                        n,
-                        ["Put", hash32,
-                         {"c": False, "p": i, "l": len(data),
-                          "s": len(pieces[i])}],
-                        prio=PRIO_NORMAL,
-                        stream=bytes_stream(pieces[i]),
-                    )
-                    for n, i in send_targets
-                ],
-                return_exceptions=True,
-            )
-        ok = {
-            t for t, r in zip(send_targets, results)
-            if not isinstance(r, Exception)
-        }
         # quorum counts DISTINCT pieces stored per layout version; tolerate
         # up to half the parity pieces missing (resync rebuilds them) — but
         # EVERY active version's node set must independently reach quorum
         m = self.codec.n_pieces - self.codec.min_pieces
         quorum_pieces = self.codec.n_pieces - m // 2
-        for vt in per_version:
-            distinct_ok = {i for (n, i) in vt if (n, i) in ok}
-            if len(distinct_ok) < quorum_pieces:
-                raise Quorum(
-                    quorum_pieces,
-                    len(distinct_ok),
-                    [repr(r) for r in results if isinstance(r, Exception)],
+
+        ok: set[tuple[bytes, int]] = set()
+        failed: set[tuple[bytes, int]] = set()
+        errors: list[str] = []
+        done_ev = asyncio.Event()
+
+        def distinct_ok(vt) -> int:
+            return len({i for (n, i) in vt if (n, i) in ok})
+
+        def satisfied() -> bool:
+            return all(distinct_ok(vt) >= quorum_pieces for vt in per_version)
+
+        def hopeless() -> bool:
+            return any(
+                len({i for (n, i) in vt if (n, i) not in failed})
+                < quorum_pieces
+                for vt in per_version
+            )
+
+        async def one(n: bytes, i: int) -> None:
+            try:
+                await self.endpoint.call(
+                    n,
+                    ["Put", hash32,
+                     {"c": False, "p": i, "l": len(data),
+                      "s": len(pieces[i])}],
+                    prio=PRIO_NORMAL,
+                    # same deadline as the caller's quorum wait below — a
+                    # longer per-send default would abort slow-but-alive
+                    # sends as "quorum failure" with an empty error list
+                    timeout=self.helper.default_timeout,
+                    stream=bytes_stream(pieces[i]),
                 )
-        # pieces that failed their primary node heal via resync
+                ok.add((n, i))
+            except Exception as e:  # noqa: BLE001 — tallied for Quorum
+                failed.add((n, i))
+                errors.append(f"{n.hex()[:8]}/p{i}: {e!r}")
+            if satisfied() or hopeless():
+                done_ev.set()
+
+        async def send_all() -> None:
+            # the reservation lives here so background-draining sends keep
+            # their piece buffers budgeted until the last one finishes
+            async with self.buffers.reserve(
+                sum(len(pieces[i]) for _n, i in send_targets)
+            ):
+                await asyncio.gather(
+                    *[one(n, i) for n, i in send_targets],
+                    return_exceptions=True,
+                )
+            done_ev.set()
+
+        from ..utils.background import spawn
+
+        sender = spawn(send_all(), name=f"ec-put-{hash32.hex()[:8]}")
+        try:
+            await asyncio.wait_for(
+                done_ev.wait(), self.helper.default_timeout + 5.0
+            )
+        except asyncio.TimeoutError:
+            pass
+        if not satisfied():
+            sender.cancel()
+            got = min((distinct_ok(vt) for vt in per_version), default=0)
+            raise Quorum(quorum_pieces, got, errors)
+        # pieces not yet confirmed on their primary node heal via resync.
+        # Queued EAGERLY (before returning success, while stragglers drain
+        # in background): a crash after this return must not leave the
+        # quorum-only block unrecorded for repair.  Queueing a block whose
+        # stragglers then succeed is a no-op for resync.
         if len(ok) < len(send_targets):
             self.resync.queue_block(hash32)
 
