@@ -45,6 +45,46 @@ def _parse_addr(s: str) -> tuple[str, int]:
     return (host.strip("[]") or "0.0.0.0", int(port))
 
 
+def _public_addr_from_subnet(subnet: str, port: int) -> tuple[str, int] | None:
+    """First local interface address inside `subnet` (CIDR), with the RPC
+    bind port — reference system.rs:885-935 get_rpc_public_addr /
+    get_default_ip filtered by rpc_public_addr_subnet."""
+    import ipaddress
+    import socket
+
+    net = ipaddress.ip_network(subnet, strict=False)
+    candidates: list[str] = []
+    # the default-route address (UDP connect performs no I/O) ...
+    probe = "8.8.8.8" if net.version == 4 else "2001:4860:4860::8888"
+    fam = socket.AF_INET if net.version == 4 else socket.AF_INET6
+    try:
+        s = socket.socket(fam, socket.SOCK_DGRAM)
+        try:
+            s.connect((probe, 9))
+            candidates.append(s.getsockname()[0])
+        finally:
+            s.close()
+    except OSError:
+        pass
+    # ... plus everything the hostname resolves to
+    try:
+        for info in socket.getaddrinfo(socket.gethostname(), None, fam):
+            candidates.append(info[4][0])
+    except OSError:
+        pass
+    for ip in candidates:
+        try:
+            if ipaddress.ip_address(ip) in net:
+                return (ip, port)
+        except ValueError:
+            continue
+    logger.warning(
+        "rpc_public_addr_subnet %s matches no local address (candidates: %s)",
+        subnet, candidates,
+    )
+    return None
+
+
 def _parse_bootstrap(entries: list[str]) -> list[tuple[bytes, tuple[str, int]]]:
     """'hexid@host:port' entries (reference: node id @ address)."""
     out = []
@@ -94,6 +134,11 @@ class Garage:
         public_addr = (
             _parse_addr(config.rpc_public_addr) if config.rpc_public_addr else None
         )
+        if public_addr is None and config.rpc_public_addr_subnet:
+            public_addr = _public_addr_from_subnet(
+                config.rpc_public_addr_subnet,
+                _parse_addr(config.rpc_bind_addr)[1],
+            )
         from ..rpc.discovery import discovery_from_config
 
         self.system = System(
@@ -111,6 +156,9 @@ class Garage:
             self.node_id, self.system.peering,
             default_timeout=config.rpc_timeout_msec / 1000.0,
         )
+        if config.rpc_ping_timeout_msec:
+            # reference system.rs:269 set_ping_timeout_millis
+            self.system.peering.ping_timeout = config.rpc_ping_timeout_msec / 1000.0
 
         codec = get_codec(
             config.ec_params(),
@@ -127,6 +175,7 @@ class Garage:
             codec=codec,
             data_fsync=config.data_fsync,
             ram_buffer_max=config.block_ram_buffer_max,
+            disable_scrub=config.disable_scrub,
         )
 
         # tables, wired with their reactive cross-links

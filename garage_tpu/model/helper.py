@@ -55,7 +55,7 @@ class GarageHelper:
     # --- bucket lifecycle -----------------------------------------------------
 
     async def create_bucket(self, name: str) -> bytes:
-        if not valid_bucket_name(name):
+        if not valid_bucket_name(name, self.garage.config.allow_punycode):
             raise Error(f"invalid bucket name {name!r}")
         async with self.lock:
             existing = await self.garage.bucket_alias_table.get(name.encode(), b"")
@@ -109,7 +109,7 @@ class GarageHelper:
     # --- aliases (reference helper/locked.rs alias ops) -----------------------
 
     async def set_global_alias(self, bucket_id: bytes, alias: str) -> None:
-        if not valid_bucket_name(alias):
+        if not valid_bucket_name(alias, self.garage.config.allow_punycode):
             raise Error(f"invalid alias {alias!r}")
         async with self.lock:
             bucket = await self.get_bucket(bucket_id)
@@ -155,7 +155,7 @@ class GarageHelper:
             await self.garage.bucket_table.insert(bucket)
 
     async def set_local_alias(self, bucket_id: bytes, key_id: str, alias: str) -> None:
-        if not valid_bucket_name(alias):
+        if not valid_bucket_name(alias, self.garage.config.allow_punycode):
             raise Error(f"invalid alias {alias!r}")
         async with self.lock:
             await self.get_bucket(bucket_id)

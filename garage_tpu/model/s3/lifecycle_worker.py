@@ -44,8 +44,12 @@ class LifecycleState(Migratable):
         return cls(obj[0], bytes(obj[1]))
 
 
-def _today() -> str:
-    return datetime.now(timezone.utc).strftime("%Y-%m-%d")
+def _today(use_local_tz: bool = False) -> str:
+    """Current date for day-boundary decisions; `use_local_tz` shifts the
+    boundary to local midnight (reference config.rs use_local_tz ->
+    lifecycle_worker.rs:73,208-222 today()/midnight_ts)."""
+    now = datetime.now().astimezone() if use_local_tz else datetime.now(timezone.utc)
+    return now.strftime("%Y-%m-%d")
 
 
 class LifecycleWorker(Worker):
@@ -66,7 +70,8 @@ class LifecycleWorker(Worker):
         return {"last_completed": self.state.last_completed}
 
     async def work(self):
-        if self.state.last_completed == _today():
+        use_local = self.garage.config.use_local_tz
+        if self.state.last_completed == _today(use_local):
             return WorkerState.IDLE
         data = self.garage.object_table.data
         n = 0
@@ -82,7 +87,7 @@ class LifecycleWorker(Worker):
                 self._save()
                 return WorkerState.BUSY
         # pass complete
-        self.state.last_completed = _today()
+        self.state.last_completed = _today(use_local)
         self.state.cursor = b""
         self._bucket_cache.clear()
         self._save()

@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import re
 import shutil
 import time
 
@@ -21,13 +22,21 @@ KEEP = 2
 
 
 def take_snapshot(garage) -> str:
-    base = os.path.join(garage.config.metadata_dir, "snapshots")
+    # metadata_snapshots_dir knob (reference config.rs:35): snapshots can
+    # live on a different volume than the live metadata
+    base = garage.config.metadata_snapshots_dir or os.path.join(
+        garage.config.metadata_dir, "snapshots"
+    )
     os.makedirs(base, exist_ok=True)
     name = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     dest = os.path.join(base, name)
     garage.db.snapshot(dest)
-    # rotate: keep the most recent KEEP
-    snaps = sorted(os.listdir(base))
+    # rotate: keep the most recent KEEP.  Only touch entries matching our
+    # timestamp naming — metadata_snapshots_dir may be a shared volume and
+    # rotation must never delete foreign data.
+    snaps = sorted(
+        e for e in os.listdir(base) if re.fullmatch(r"\d{8}T\d{6}Z", e)
+    )
     for old in snaps[:-KEEP]:
         shutil.rmtree(os.path.join(base, old), ignore_errors=True)
     logger.info("metadata snapshot written to %s", dest)

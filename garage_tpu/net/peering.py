@@ -52,6 +52,9 @@ class PeeringManager:
         # config.rs rpc_public_addr); defaults to the bind address, which
         # is fine for loopback dev clusters and tests
         self.public_addr = public_addr
+        # per-instance override of the module default (reference
+        # config.rs rpc_ping_timeout_msec -> system.rs:269)
+        self.ping_timeout = PING_TIMEOUT
         self.peers: dict[bytes, PeerInfo] = {}
         for pid, addr in bootstrap:
             if pid != netapp.id:
@@ -141,7 +144,7 @@ class PeeringManager:
         t0 = time.monotonic()
         try:
             resp = await self.ping_ep.call(
-                p.id, nonce, prio=PRIO_HIGH, timeout=PING_TIMEOUT
+                p.id, nonce, prio=PRIO_HIGH, timeout=self.ping_timeout
             )
             if resp.body != nonce:
                 raise ValueError("ping nonce mismatch")
@@ -151,7 +154,8 @@ class PeeringManager:
             p.state = "up"
             # piggyback peer-list exchange on successful pings
             resp = await self.peerlist_ep.call(
-                p.id, self._known_list(), prio=PRIO_HIGH, timeout=PING_TIMEOUT
+                p.id, self._known_list(), prio=PRIO_HIGH,
+                timeout=self.ping_timeout,
             )
             self._learn(resp.body or [])
         except Exception:  # noqa: BLE001

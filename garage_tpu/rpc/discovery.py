@@ -34,7 +34,27 @@ class ConsulDiscovery:
         self.token = cfg.token
         self.tags = list(cfg.tags or [])
         self.meta = dict(cfg.meta or {})
+        # TLS knobs (reference config.rs ConsulDiscoveryConfig + consul.rs
+        # client builder): private CA, mutual-TLS client cert, skip-verify
+        self.ca_cert = cfg.ca_cert
+        self.client_cert = cfg.client_cert
+        self.client_key = cfg.client_key
+        self.tls_skip_verify = cfg.tls_skip_verify
         self._session = None
+
+    def _ssl(self):
+        """ssl.SSLContext for the consul endpoint, or None for defaults."""
+        if not (self.ca_cert or self.client_cert or self.tls_skip_verify):
+            return None
+        import ssl
+
+        ctx = ssl.create_default_context(cafile=self.ca_cert)
+        if self.client_cert:
+            ctx.load_cert_chain(self.client_cert, self.client_key)
+        if self.tls_skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
 
     def _sess(self):
         import aiohttp
@@ -43,7 +63,13 @@ class ConsulDiscovery:
             headers = {}
             if self.token:
                 headers["x-consul-token"] = self.token
-            self._session = aiohttp.ClientSession(headers=headers)
+            ssl_ctx = self._ssl()
+            connector = (
+                aiohttp.TCPConnector(ssl=ssl_ctx) if ssl_ctx is not None else None
+            )
+            self._session = aiohttp.ClientSession(
+                headers=headers, connector=connector
+            )
         return self._session
 
     async def close(self) -> None:

@@ -48,7 +48,9 @@ class WebConfig:
 class AdminConfig:
     api_bind_addr: str | None = None
     admin_token: str | None = None
+    admin_token_file: str | None = None
     metrics_token: str | None = None
+    metrics_token_file: str | None = None
     trace_sink: str | None = None
 
 
@@ -62,6 +64,12 @@ class ConsulDiscoveryConfig:
     token: str | None = None
     tags: list[str] = field(default_factory=list)
     meta: dict[str, str] = field(default_factory=dict)
+    # TLS to the consul endpoint (reference config.rs ca_cert/client_cert/
+    # client_key/tls_skip_verify)
+    ca_cert: str | None = None
+    client_cert: str | None = None
+    client_key: str | None = None
+    tls_skip_verify: bool = False
 
 
 @dataclass
@@ -95,6 +103,10 @@ class Config:
     metadata_fsync: bool = True
     data_fsync: bool = False
     metadata_auto_snapshot_interval: int | None = None  # msec
+    metadata_snapshots_dir: str | None = None  # default <metadata_dir>/snapshots
+    disable_scrub: bool = False
+    use_local_tz: bool = False  # lifecycle worker day boundaries
+    allow_punycode: bool = False  # xn-- bucket names/aliases
 
     block_size: int = DEFAULT_BLOCK_SIZE
     block_ram_buffer_max: int = 256 * 1024 * 1024
@@ -111,7 +123,11 @@ class Config:
     rpc_bind_addr: str = "127.0.0.1:3901"
     rpc_bind_outgoing: bool = False
     rpc_public_addr: str | None = None
+    # pick the public address automatically: first local interface address
+    # inside this CIDR (reference config.rs rpc_public_addr_subnet)
+    rpc_public_addr_subnet: str | None = None
     rpc_timeout_msec: int = 10_000
+    rpc_ping_timeout_msec: int | None = None  # default net/peering.PING_TIMEOUT
 
     bootstrap_peers: list[str] = field(default_factory=list)
 
@@ -178,9 +194,11 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             "metadata_dir db_engine metadata_fsync data_fsync block_size "
             "block_ram_buffer_max replication_factor consistency_mode "
             "replication_mode rpc_secret rpc_secret_file rpc_bind_addr "
-            "rpc_bind_outgoing rpc_public_addr rpc_timeout_msec "
+            "rpc_bind_outgoing rpc_public_addr rpc_public_addr_subnet "
+            "rpc_timeout_msec rpc_ping_timeout_msec "
             "bootstrap_peers allow_world_readable_secrets "
-            "metadata_auto_snapshot_interval"
+            "metadata_auto_snapshot_interval metadata_snapshots_dir "
+            "disable_scrub use_local_tz allow_punycode"
         ).split()
     }
     for k, v in raw.items():
@@ -234,10 +252,16 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         cfg.allow_world_readable_secrets,
     )
     cfg.admin.admin_token = _get_secret(
-        cfg.admin.admin_token, None, "GARAGE_ADMIN_TOKEN", True
+        cfg.admin.admin_token,
+        cfg.admin.admin_token_file,
+        "GARAGE_ADMIN_TOKEN",
+        cfg.allow_world_readable_secrets,
     )
     cfg.admin.metrics_token = _get_secret(
-        cfg.admin.metrics_token, None, "GARAGE_METRICS_TOKEN", True
+        cfg.admin.metrics_token,
+        cfg.admin.metrics_token_file,
+        "GARAGE_METRICS_TOKEN",
+        cfg.allow_world_readable_secrets,
     )
     # parity with reference legacy replication_mode values
     # ("1"|"2"|"3"|"2-dangerous"|"3-degraded"|"3-dangerous",

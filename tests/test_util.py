@@ -186,3 +186,77 @@ def test_migrate_fallthrough_on_bad_payload():
     assert got.migrated == 7
     with pytest.raises(Exception):
         ThingV0.decode(bad + b"")  # V0 has no PREVIOUS: error surfaces
+
+
+def test_config_new_knobs(tmp_path):
+    """Round-3 parity knobs: admin token files, scrub/tz/punycode toggles,
+    snapshot dir, ping timeout, public-addr subnet, consul TLS
+    (reference src/util/config.rs:28-141)."""
+    tok = tmp_path / "admin_tok"
+    tok.write_text("s3cret\n")
+    tok.chmod(0o600)
+    cfg = config_from_dict(
+        {
+            "metadata_snapshots_dir": "/snapvol/snaps",
+            "disable_scrub": True,
+            "use_local_tz": True,
+            "allow_punycode": True,
+            "rpc_ping_timeout_msec": 2000,
+            "rpc_public_addr_subnet": "10.0.0.0/8",
+            "admin": {"admin_token_file": str(tok)},
+            "consul_discovery": {
+                "consul_http_addr": "https://consul:8501",
+                "ca_cert": "/pki/ca.pem",
+                "tls_skip_verify": True,
+            },
+        }
+    )
+    assert cfg.metadata_snapshots_dir == "/snapvol/snaps"
+    assert cfg.disable_scrub and cfg.use_local_tz and cfg.allow_punycode
+    assert cfg.rpc_ping_timeout_msec == 2000
+    assert cfg.rpc_public_addr_subnet == "10.0.0.0/8"
+    assert cfg.admin.admin_token == "s3cret"
+    assert cfg.consul_discovery.ca_cert == "/pki/ca.pem"
+    assert cfg.consul_discovery.tls_skip_verify
+
+
+def test_config_admin_token_file_world_readable_refused(tmp_path):
+    tok = tmp_path / "admin_tok"
+    tok.write_text("s3cret\n")
+    tok.chmod(0o644)
+    with pytest.raises(ValueError, match="group/others"):
+        config_from_dict({"admin": {"admin_token_file": str(tok)}})
+
+
+def test_valid_bucket_name_rules():
+    from garage_tpu.model.bucket_alias_table import valid_bucket_name
+
+    assert valid_bucket_name("my-bucket.v2")
+    assert not valid_bucket_name("ab")  # too short
+    assert not valid_bucket_name("-lead")
+    assert not valid_bucket_name("trail-")
+    assert not valid_bucket_name("192.168.1.1")  # IP-formatted
+    assert not valid_bucket_name("xn--bcher-kva")  # punycode refused...
+    assert valid_bucket_name("xn--bcher-kva", allow_punycode=True)  # ...unless allowed
+    assert not valid_bucket_name("foo.xn--p1ai")
+    assert valid_bucket_name("foo.xn--p1ai", allow_punycode=True)
+    assert not valid_bucket_name("mybucket-s3alias")  # reserved suffix
+
+
+def test_public_addr_from_subnet():
+    from garage_tpu.model.garage import _public_addr_from_subnet
+
+    import ipaddress
+
+    # 0.0.0.0/0 matches any discoverable v4 address
+    got = _public_addr_from_subnet("0.0.0.0/0", 3901)
+    if got is None:
+        return  # sandbox with no discoverable v4 address: nothing to check
+    ip, port = got
+    assert port == 3901 and "." in ip
+    # the /32 of the discovered address matches exactly...
+    assert _public_addr_from_subnet(f"{ip}/32", 3901) == (ip, 3901)
+    # ...and a disjoint /32 next to it never does
+    neighbor = ipaddress.ip_address(ip) + (1 if ip != "255.255.255.255" else -1)
+    hit = _public_addr_from_subnet(f"{neighbor}/32", 3901)
+    assert hit is None or hit[0] == str(neighbor)  # only if genuinely local
