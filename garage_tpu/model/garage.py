@@ -156,6 +156,15 @@ class Garage:
             self.node_id, self.system.peering,
             default_timeout=config.rpc_timeout_msec / 1000.0,
         )
+
+        def _zone_of(nid: bytes) -> str | None:
+            for v in reversed(self.layout_manager.history.versions):
+                role = v.roles.get(nid)
+                if role is not None:
+                    return role.zone
+            return None
+
+        self.helper_rpc.zone_of = _zone_of
         if config.rpc_ping_timeout_msec:
             # reference system.rs:269 set_ping_timeout_millis
             self.system.peering.ping_timeout = config.rpc_ping_timeout_msec / 1000.0

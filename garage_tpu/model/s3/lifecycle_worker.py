@@ -123,9 +123,17 @@ class LifecycleWorker(Worker):
                     expired = age_days >= rule["expiration_days"]
                 if rule.get("expiration_date"):
                     try:
+                        # the rule date is a day boundary: local midnight
+                        # when use_local_tz, else UTC midnight (reference
+                        # lifecycle_worker.rs:389 midnight_ts)
+                        tz = (
+                            datetime.now().astimezone().tzinfo
+                            if self.garage.config.use_local_tz
+                            else timezone.utc
+                        )
                         d = datetime.strptime(
                             rule["expiration_date"][:10], "%Y-%m-%d"
-                        ).replace(tzinfo=timezone.utc)
+                        ).replace(tzinfo=tz)
                         expired = expired or now >= d.timestamp() * 1000
                     except ValueError:
                         pass

@@ -38,18 +38,29 @@ class RpcHelper:
         self.our_id = our_id
         self.peering = peering
         self.default_timeout = default_timeout
+        # node_id -> zone name (or None), wired by the composition root
+        # from the current cluster layout; used by request_order
+        self.zone_of = None
 
     # --- ordering ------------------------------------------------------------
 
     def request_order(self, nodes: list[bytes]) -> list[bytes]:
-        """Self first, then nodes by ascending observed ping rtt
-        (reference rpc_helper.rs:621-)."""
+        """Self first, then same-zone nodes, then by ascending observed
+        ping rtt (reference rpc_helper.rs:621-648: "priorize ourself, then
+        nodes in the same zone, and within a same zone ... lowest
+        latency").  Zone lookup comes from `self.zone_of` (wired to the
+        cluster layout by the composition root); without it the order
+        degrades to self-then-rtt."""
+        our_zone = self.zone_of(self.our_id) if self.zone_of else None
 
         def key(n: bytes):
             if n == self.our_id:
-                return (0, 0.0, n)
+                return (0, 0, 0.0, n)
+            other_zone = (
+                1 if our_zone is None or self.zone_of(n) != our_zone else 0
+            )
             rtt = self.peering.peer_avg_rtt(n)
-            return (1, rtt if rtt is not None else 9.0, n)
+            return (1, other_zone, rtt if rtt is not None else 9.0, n)
 
         return sorted(nodes, key=key)
 

@@ -161,8 +161,15 @@ class Config:
 def _get_secret(
     inline: str | None, file_path: str | None, env_name: str, allow_world_readable: bool
 ) -> str | None:
-    """Secret layering (reference src/garage/secrets.rs): env overrides file
-    overrides inline; file must not be world-readable."""
+    """Secret layering (reference src/garage/secrets.rs): env overrides;
+    inline + file together is an ambiguous config and refused
+    (secrets.rs:98 "only one of `x` and `x_file` can be set"); file must
+    not be world-readable."""
+    if inline and file_path:
+        raise ValueError(
+            f"only one of the inline secret and its _file variant may be "
+            f"set (env {env_name})"
+        )
     env = os.environ.get(env_name)
     if env:
         return env.strip()

@@ -137,3 +137,60 @@ def test_ec_s3_end_to_end(tmp_path):
             await stop_cluster(garages, [s3_0, s3_2], [c0, c2])
 
     run(main())
+
+
+def test_ec164_wide_stripe_survives_4_node_loss(tmp_path):
+    """BASELINE.md staged config 'EC(16,4) wide-stripe': a 21-node
+    cluster (rf = 20) takes a multi-block object through the EC(16,4)
+    write path, then serves it with FOUR nodes' shards wholesale gone
+    (the full parity budget), and resync reconstructs a wiped node."""
+
+    async def main():
+        # spawn=False: 21 nodes' background workers (sync rounds against
+        # 20 peers each) starve the single-threaded test loop; the test
+        # drives resync by hand anyway
+        garages = await make_ec_cluster(
+            tmp_path, n=21, mode="ec:16:4", block_size=16384, spawn=False
+        )
+        s3 = S3ApiServer(garages[0])
+        await s3.start("127.0.0.1", 0)
+        ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
+        key = await garages[0].helper.create_key("wide")
+        key.params().allow_create_bucket.update(True)
+        await garages[0].key_table.insert(key)
+        c = S3Client(ep, key.key_id, key.secret())
+        try:
+            await c.create_bucket("wide")
+            big = os.urandom(100_000)  # 7 blocks at 16 KiB
+            await c.put_object("wide", "wide.bin", big)
+            assert await c.get_object("wide", "wide.bin") == big
+
+            # wipe the piece files of 4 whole nodes (m = 4): any 16 of
+            # the remaining shards must still decode every block
+            wiped_nodes = garages[1:5]
+            for g in wiped_nodes:
+                bm = g.block_manager
+                for h, _v in bm.rc.tree.iter_range():
+                    for _pi, (path, _c) in bm.local_pieces(h).items():
+                        os.remove(path)
+            got = await c.get_object("wide", "wide.bin")
+            assert got == big, "decode failed with m=4 nodes of shards lost"
+
+            # resync on one wiped node reconstructs its ranks
+            bm = wiped_nodes[0].block_manager
+            for h, _v in bm.rc.tree.iter_range():
+                if bm.rc.is_needed(h):
+                    bm.resync.queue_block(h)
+            for _ in range(300):
+                if not await bm.resync.resync_iter():
+                    break
+            healed = sum(
+                1
+                for h, _v in bm.rc.tree.iter_range()
+                if bm.rc.is_needed(h) and bm.local_pieces(h)
+            )
+            assert healed > 0, "resync reconstructed nothing on wiped node"
+        finally:
+            await stop_cluster(garages, [s3], [c])
+
+    run(main())

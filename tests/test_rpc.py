@@ -205,3 +205,25 @@ def test_try_write_many_sets():
             await stop_cluster(apps, systems)
 
     run(main())
+
+
+def test_request_order_zone_preference():
+    """Reference rpc_helper.rs:621-648: self first, then same-zone nodes,
+    then ascending ping rtt.  A remote same-zone node must outrank a
+    lower-latency cross-zone node."""
+
+    class FakePeering:
+        def __init__(self, rtts):
+            self.rtts = rtts
+
+        def peer_avg_rtt(self, n):
+            return self.rtts.get(n)
+
+    me, a, b, c = b"\x00" * 32, b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+    zones = {me: "dc1", a: "dc2", b: "dc1", c: "dc2"}
+    helper = RpcHelper(me, FakePeering({a: 0.001, b: 0.200, c: 0.050}))
+    # without zone wiring: self, then pure rtt order
+    assert helper.request_order([c, b, a, me]) == [me, a, c, b]
+    helper.zone_of = zones.get
+    # with zones: self, same-zone b (despite 200ms), then a/c by rtt
+    assert helper.request_order([c, b, a, me]) == [me, b, a, c]

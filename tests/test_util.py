@@ -260,3 +260,11 @@ def test_public_addr_from_subnet():
     neighbor = ipaddress.ip_address(ip) + (1 if ip != "255.255.255.255" else -1)
     hit = _public_addr_from_subnet(f"{neighbor}/32", 3901)
     assert hit is None or hit[0] == str(neighbor)  # only if genuinely local
+
+
+def test_secret_inline_plus_file_refused(tmp_path):
+    f = tmp_path / "sec"
+    f.write_text("x")
+    f.chmod(0o600)
+    with pytest.raises(ValueError, match="only one of"):
+        config_from_dict({"rpc_secret": "inline", "rpc_secret_file": str(f)})
