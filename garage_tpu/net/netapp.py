@@ -18,6 +18,20 @@ from .message import PRIO_NORMAL, Req, Resp
 logger = logging.getLogger("garage.net")
 
 
+def _set_nodelay(writer: asyncio.StreamWriter) -> None:
+    """Disable Nagle on RPC sockets: a request/response pattern with
+    small frames can otherwise stall on the delayed-ACK timer per round
+    trip on real networks (loopback benches are unaffected)."""
+    import socket
+
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
 class RpcError(Exception):
     pass
 
@@ -115,6 +129,7 @@ class NetApp:
         logger.info("%s listening on %s:%d", self.id.hex()[:8], host, self.bind_addr[1])
 
     async def _accept(self, reader, writer) -> None:
+        _set_nodelay(writer)
         try:
             box = await asyncio.wait_for(
                 handshake(
@@ -144,6 +159,7 @@ class NetApp:
             if peer_id is not None and peer_id in self.conns:
                 return peer_id
             reader, writer = await asyncio.open_connection(addr[0], addr[1])
+            _set_nodelay(writer)
             try:
                 box = await asyncio.wait_for(
                     handshake(

@@ -140,6 +140,20 @@ class LayoutHistory:
     def digest(self) -> bytes:
         return blake2sum(pack(self.to_obj()))
 
+    def placement_digest(self) -> bytes:
+        """Digest of the placement-relevant state only: layout versions
+        and their ring assignments — NOT the update trackers.  Tracker
+        gossip advances constantly during normal operation; anti-entropy
+        consumers key off this digest so tracker-only updates don't
+        retrigger full sync rounds (each one is ~512 root-compare RPCs
+        per table)."""
+        return blake2sum(
+            pack([
+                [v.version, v.node_id_vec, v.ring_assignment]
+                for v in self.versions
+            ])
+        )
+
     def staging_digest(self) -> bytes:
         return blake2sum(pack(self.staging.to_obj()))
 

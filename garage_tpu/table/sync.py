@@ -204,6 +204,7 @@ class _SyncWorker(Worker):
         self.syncer = syncer
         self.last_sync = 0.0
         self.last_stats: dict = {}
+        self._last_placement: bytes | None = None
 
     def name(self) -> str:
         return f"sync:{self.syncer.table.schema.table_name}"
@@ -213,20 +214,32 @@ class _SyncWorker(Worker):
 
     async def work(self):
         now = time.monotonic()
+        lm = self.syncer.table.system.layout_manager
         due = now - self.last_sync >= ANTI_ENTROPY_INTERVAL
+        # placement digest captured BEFORE the round: a version applied
+        # mid-round changes the live digest, so the next wakeup re-rounds
+        placement = lm.history.placement_digest()
         if self.syncer._layout_changed.is_set():
             self.syncer._layout_changed.clear()
-            due = True
+            # layout notifications also fire for tracker-only gossip
+            # (ack/sync movement), which happens constantly under write
+            # load; a full root-compare round (~512 RPCs/table) is only
+            # warranted when the PLACEMENT changed
+            if placement != self._last_placement:
+                due = True
         if not due:
             return WorkerState.IDLE
         self.last_sync = now
-        lm = self.syncer.table.system.layout_manager
         # the round guarantees convergence only up to the version current
         # when it STARTED; a layout applied mid-round re-triggers via
         # _layout_changed, and the next round reports the newer version
         v0 = lm.history.current().version
         self.last_stats = await self.syncer.sync_all_partitions()
         if self.last_stats.get("errors", 0) == 0:
+            # only a CLEAN round retires the trigger — a failed round
+            # (partitioned peer) keeps retrying on subsequent wakeups
+            # instead of stalling until the 10-minute interval
+            self._last_placement = placement
             lm.component_synced(
                 f"table:{self.syncer.table.schema.table_name}", v0
             )
