@@ -19,6 +19,8 @@ from typing import Any
 
 from ..net.message import PRIO_HIGH, Req, Resp
 from ..utils.background import spawn
+from ..utils.data import blake2sum
+from ..utils.serde import pack
 from ..net.netapp import NetApp
 from ..net.peering import PeeringManager
 from ..utils.migrate import Migratable
@@ -30,6 +32,7 @@ logger = logging.getLogger("garage.system")
 
 STATUS_EXCHANGE_INTERVAL = 10.0
 DISCOVERY_INTERVAL = 60.0
+ADVERTISE_COALESCE = 0.2  # burst-coalescing window for layout gossip
 
 
 @dataclass
@@ -121,6 +124,12 @@ class System:
         self.peering = PeeringManager(netapp, known, public_addr=public_addr)
         self.node_status: dict[bytes, tuple[NodeStatus, float]] = {}
         self._tasks: list[asyncio.Task] = []
+        # coalesced layout gossip state (see _advertise_loop)
+        self._adv_event = asyncio.Event()
+        self._adv_sem = asyncio.Semaphore(8)
+        self._advertised: dict[bytes, bytes] = {}  # peer -> last digest sent
+        self._adv_inflight: set[bytes] = set()
+        self._adv_latest: bytes | None = None  # last wave's snapshot digest
 
         self.status_ep = netapp.endpoint("rpc/system/status")
         self.status_ep.set_handler(self._handle_status)
@@ -136,6 +145,7 @@ class System:
         self.peering.start()
         self._tasks.append(asyncio.create_task(self._status_loop()))
         self._tasks.append(asyncio.create_task(self._discovery_loop()))
+        self._tasks.append(asyncio.create_task(self._advertise_loop()))
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -197,20 +207,65 @@ class System:
         return Resp(None)
 
     def _on_layout_change(self) -> None:
-        # broadcast the merged layout to all connected peers (gossip)
-        try:
-            loop = asyncio.get_running_loop()
-        except RuntimeError:
-            return
-        obj = self.layout_manager.history.to_obj()
-        for pid in self.peering.connected_peers():
-            spawn(self._advertise_to(pid, obj))
+        # Coalesced gossip: mark dirty and let _advertise_loop push ONE
+        # snapshot per burst.  Broadcasting on every CRDT delta is an
+        # amplification bomb on a full mesh: each of n nodes' tracker
+        # bumps re-triggers an n-peer broadcast on each of n nodes —
+        # a 21-node layout apply was observed to pile up >13k concurrent
+        # advertise tasks and starve the event loop for ~70 s.
+        self._adv_event.set()
 
-    async def _advertise_to(self, pid: bytes, obj: Any) -> None:
+    async def _advertise_loop(self) -> None:
+        """Push the layout to peers when it changed, one wave per burst.
+        Per-peer digest suppression avoids re-sending a snapshot the peer
+        was already sent; the status loop's digest-mismatch pull is the
+        convergence backstop for lost adverts.  Waves never await their
+        sends: a hung peer occupies one in-flight slot, it does not delay
+        the next wave to the healthy peers."""
+        while True:
+            await self._adv_event.wait()
+            await asyncio.sleep(ADVERTISE_COALESCE)
+            self._adv_event.clear()
+            try:
+                obj = self.layout_manager.history.to_obj()
+                # same bytes as layout_manager.digest() without packing
+                # the history a second time (waves fire every 0.2 s
+                # under tracker churn)
+                digest = blake2sum(pack(obj))
+                self._adv_latest = digest
+                connected = set(self.peering.connected_peers())
+                # drop suppression state for departed peers (a reconnecting
+                # peer with an unchanged digest is covered by the status
+                # loop's pull backstop)
+                self._advertised = {
+                    p: d for p, d in self._advertised.items() if p in connected
+                }
+                for p in connected:
+                    if (
+                        self._advertised.get(p) != digest
+                        and p not in self._adv_inflight
+                    ):
+                        self._adv_inflight.add(p)
+                        spawn(self._advertise_one(p, obj, digest))
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                logger.exception("advertise loop error")
+
+    async def _advertise_one(self, pid: bytes, obj: Any, digest: bytes) -> None:
         try:
-            await self.adv_layout_ep.call(pid, obj, prio=PRIO_HIGH)
+            async with self._adv_sem:  # bounded fan-out on wide meshes
+                await self.adv_layout_ep.call(pid, obj, prio=PRIO_HIGH, timeout=10.0)
+            self._advertised[pid] = digest
         except Exception as e:  # noqa: BLE001
             logger.debug("layout advertise to %s failed: %r", pid.hex()[:8], e)
+        finally:
+            self._adv_inflight.discard(pid)
+            # the layout may have moved on while this send was in flight
+            # (waves skip in-flight peers): retrigger so the peer gets
+            # the newer snapshot
+            if digest != self._adv_latest:
+                self._adv_event.set()
 
     # --- loops ---------------------------------------------------------------
 

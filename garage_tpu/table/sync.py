@@ -205,6 +205,7 @@ class _SyncWorker(Worker):
         self.last_sync = 0.0
         self.last_stats: dict = {}
         self._last_placement: bytes | None = None
+        self._retry_backoff = 0.0  # grows while rounds keep failing
 
     def name(self) -> str:
         return f"sync:{self.syncer.table.schema.table_name}"
@@ -221,12 +222,19 @@ class _SyncWorker(Worker):
         placement = lm.history.placement_digest()
         if self.syncer._layout_changed.is_set():
             self.syncer._layout_changed.clear()
-            # layout notifications also fire for tracker-only gossip
-            # (ack/sync movement), which happens constantly under write
-            # load; a full root-compare round (~512 RPCs/table) is only
-            # warranted when the PLACEMENT changed
-            if placement != self._last_placement:
-                due = True
+        # layout notifications also fire for tracker-only gossip
+        # (ack/sync movement), which happens constantly under write
+        # load; a full root-compare round (~512 RPCs/table) is only
+        # warranted when the PLACEMENT changed.  Checked OUTSIDE the
+        # event gate: a failed round leaves _last_placement stale, so
+        # wakeups keep retrying until a round completes cleanly — with
+        # exponential backoff so a long peer outage doesn't amplify
+        # into back-to-back full rounds against the dead node
+        if (
+            placement != self._last_placement
+            and now - self.last_sync >= self._retry_backoff
+        ):
+            due = True
         if not due:
             return WorkerState.IDLE
         self.last_sync = now
@@ -240,8 +248,13 @@ class _SyncWorker(Worker):
             # (partitioned peer) keeps retrying on subsequent wakeups
             # instead of stalling until the 10-minute interval
             self._last_placement = placement
+            self._retry_backoff = 0.0
             lm.component_synced(
                 f"table:{self.syncer.table.schema.table_name}", v0
+            )
+        else:
+            self._retry_backoff = min(
+                self._retry_backoff * 2 or 10.0, ANTI_ENTROPY_INTERVAL
             )
         return WorkerState.IDLE
 
