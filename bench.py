@@ -42,13 +42,14 @@ def parse_args(argv):
     ap.add_argument("--k", type=int, default=8)
     ap.add_argument("--m", type=int, default=3)
     ap.add_argument("--block-bytes", type=int, default=1024 * 1024)
-    # 2048 x 1 MiB blocks per dispatch: measured on the v5e (2026-07-29)
-    # the encode rate keeps climbing with batch as dispatch/tunnel overhead
-    # amortizes — 64->21.4, 128->36.4, 256->52.1, 512->67.7, 1024->79.6,
-    # 2048->86.6, 4096->91.7 GB/s.  2048 is the default: within 6% of the
-    # 4 GiB-batch rate at half the HBM footprint (the CPU fallback path
-    # overrides this with --batch 8, see main()).
-    ap.add_argument("--batch", type=int, default=2048, help="blocks per dispatch")
+    # Default blocks-per-dispatch is backend-dependent (resolved in
+    # child_main): 2048 on an accelerator, 8 on CPU.  Measured on the v5e
+    # (2026-07-29), encode rate climbs with batch as dispatch/tunnel
+    # overhead amortizes — 64->21.4, 128->36.4, 256->52.1, 512->67.7,
+    # 1024->79.6, 2048->86.6, 4096->91.7 GB/s; 2048 is within 6% of the
+    # 4 GiB-batch rate at half the HBM footprint.  On CPU a 2 GiB batch
+    # would OOM/time-out the 1-core box, hence the per-backend default.
+    ap.add_argument("--batch", type=int, default=None, help="blocks per dispatch")
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--hash", action="store_true", help="fuse BLAKE3 shard hashing")
@@ -77,9 +78,11 @@ def child_main(args) -> None:
     shard_bytes = args.block_bytes // k
     pipe = ScrubRepairPipeline(k=k, m=m, shard_bytes=shard_bytes)
 
+    dev = jax.devices()[0]
+    if args.batch is None:
+        args.batch = 8 if dev.platform == "cpu" else 2048
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, (args.batch, k, shard_bytes), dtype=np.uint8)
-    dev = jax.devices()[0]
     data_dev = jax.device_put(jnp.asarray(data), dev)
     if args.verbose:
         print(f"# backend={dev.platform} device={dev}", file=sys.stderr)

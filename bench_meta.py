@@ -161,8 +161,13 @@ def main() -> None:
     n_puts = 150 if quick else N_S3_PUTS
     n_list = 150 if quick else N_LIST_KEYS
 
+    from garage_tpu import _native
+
+    engines = ["sqlite", "log"]
+    if _native.available():
+        engines.append("native")
     detail = {}
-    for engine in ("sqlite", "log"):
+    for engine in engines:
         detail[engine] = bench_db_engine(engine, n_db)
         detail[engine].update(
             asyncio.run(bench_s3_meta(engine, n_puts, n_list))

@@ -21,7 +21,7 @@ logger = logging.getLogger("garage.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _DEFAULT_SO = os.path.join(_DIR, "libgarage_native.so")
-_SOURCES = ["gf8.cpp", "blake3.cpp"]
+_SOURCES = ["gf8.cpp", "blake3.cpp", "kvlog.cpp"]
 
 _lib: ctypes.CDLL | None = None
 _tried = False
@@ -56,35 +56,98 @@ def build(force: bool = False) -> str | None:
     override — that env var points at an externally-built (e.g.
     sanitizer-instrumented) library which must not be overwritten with an
     uninstrumented one."""
-    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
-    tag_file = _DEFAULT_SO + ".host"
+    return _compile(
+        [os.path.join(_DIR, s) for s in _SOURCES],
+        _DEFAULT_SO,
+        extra_flags=["-pthread"],
+        force=force,
+    )
+
+
+def _compile(
+    srcs: list[str],
+    out_so: str,
+    extra_flags: list[str],
+    tag_extra: str = "",
+    force: bool = False,
+) -> str | None:
+    """Shared compile-and-cache: rebuild out_so when a source is newer or
+    the host tag changed (-march=native binaries are host-specific; no
+    ISA fingerprint -> portable build, cacheable anywhere)."""
+    tag_file = out_so + ".host"
     host = _host_tag()
-    # no ISA fingerprint -> portable build: cacheable on any host of this
-    # arch, at the cost of the SIMD fast paths
-    want_tag = host if host is not None else "portable"
-    if not force and os.path.exists(_DEFAULT_SO):
+    want_tag = (host if host is not None else "portable") + tag_extra
+    if not force and os.path.exists(out_so):
         newest = max(os.path.getmtime(s) for s in srcs)
         try:
             with open(tag_file) as f:
                 tag_ok = f.read().strip() == want_tag
         except OSError:
             tag_ok = False
-        if os.path.getmtime(_DEFAULT_SO) >= newest and tag_ok:
-            return _DEFAULT_SO
+        if os.path.getmtime(out_so) >= newest and tag_ok:
+            return out_so
     march = ["-march=native"] if host is not None else []
     cmd = [
-        "g++", "-O3", *march, "-pthread", "-shared", "-fPIC",
-        "-std=c++17", "-o", _DEFAULT_SO, *srcs,
+        "g++", "-O3", *march, *extra_flags, "-shared", "-fPIC",
+        "-std=c++17", "-o", out_so, *srcs,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         with open(tag_file, "w") as f:
             f.write(want_tag)
-        return _DEFAULT_SO
+        return out_so
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired, FileNotFoundError) as e:
         err = getattr(e, "stderr", b"")
-        logger.warning("native build failed (%r): %s", e, err.decode(errors="replace")[:500] if err else "")
+        logger.warning(
+            "native build of %s failed (%r): %s", os.path.basename(out_so),
+            e, err.decode(errors="replace")[:500] if err else "",
+        )
         return None
+
+
+_KV_SO = os.path.join(_DIR, "garage_kv.so")
+_kv_mod = None
+_kv_tried = False
+
+
+def build_kv(force: bool = False) -> str | None:
+    """Compile the CPython C-API binding of the metadata engine
+    (kvpy.cpp + kvlog.cpp -> garage_kv.so).  Separate from the ctypes
+    .so: it needs Python.h and a matching interpreter ABI."""
+    import sysconfig
+
+    inc = sysconfig.get_paths().get("include")
+    if inc is None or not os.path.exists(os.path.join(inc, "Python.h")):
+        return None
+    return _compile(
+        [os.path.join(_DIR, s) for s in ("kvpy.cpp", "kvlog.cpp")],
+        _KV_SO,
+        extra_flags=[f"-I{inc}"],
+        tag_extra=":" + str(sysconfig.get_config_var("SOABI")),
+        force=force,
+    )
+
+
+def kv_module():
+    """The garage_kv extension module, building it on first use; None if
+    unavailable (db/native_engine.py then uses the ctypes path)."""
+    global _kv_mod, _kv_tried
+    if _kv_mod is not None or _kv_tried:
+        return _kv_mod
+    _kv_tried = True
+    so = build_kv()
+    if so is None:
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("garage_kv", so)
+    try:
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _kv_mod = mod
+    except Exception as e:  # noqa: BLE001
+        logger.warning("cannot load garage_kv module: %r", e)
+    return _kv_mod
 
 
 def lib() -> ctypes.CDLL | None:
@@ -108,8 +171,38 @@ def lib() -> ctypes.CDLL | None:
         l.blake3_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p
         ]
+        # kvlog: native metadata engine (db/native_engine.py)
+        l.kv_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        l.kv_open.restype = ctypes.c_void_p
+        l.kv_close.argtypes = [ctypes.c_void_p]
+        l.kv_commit.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        l.kv_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ]
+        l.kv_tree_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        l.kv_tree_len.restype = ctypes.c_uint64
+        l.kv_tree_names.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        l.kv_tree_names.restype = ctypes.c_size_t
+        l.kv_iter_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        l.kv_iter_chunk.restype = ctypes.c_size_t
+        l.kv_compact_now.argtypes = [ctypes.c_void_p]
+        l.kv_log_bytes.argtypes = [ctypes.c_void_p]
+        l.kv_log_bytes.restype = ctypes.c_uint64
+        l.kv_live_bytes.argtypes = [ctypes.c_void_p]
+        l.kv_live_bytes.restype = ctypes.c_uint64
         _lib = l
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: an externally-built .so (GARAGE_NATIVE_SO) from
+        # before a symbol was added — degrade to the Python fallbacks
+        # rather than crashing available() callers
         logger.warning("cannot load native library: %r", e)
     return _lib
 

@@ -20,10 +20,16 @@ def open_db(path: str, engine: str = "sqlite", fsync: bool = True) -> Db:
         if os.path.isdir(path) or not os.path.splitext(path)[1]:
             path = os.path.join(path, "db.log")
         return LogDb(path, fsync=fsync)
+    if engine == "native":
+        from .native_engine import NativeDb
+
+        if os.path.isdir(path) or not os.path.splitext(path)[1]:
+            path = os.path.join(path, "db.log")  # WAL-compatible with "log"
+        return NativeDb(path, fsync=fsync)
     if engine == "memory":
         from .memory_engine import MemDb
 
         return MemDb()
     raise ValueError(
-        f"unknown db engine {engine!r} (supported: sqlite, log, memory)"
+        f"unknown db engine {engine!r} (supported: sqlite, log, native, memory)"
     )

@@ -99,7 +99,7 @@ class Config:
     metadata_dir: str = ""
     data_dir: list[DataDir] = field(default_factory=list)
 
-    db_engine: str = "sqlite"  # "sqlite" | "log" | "memory" (reference: lmdb|sqlite)
+    db_engine: str = "sqlite"  # "sqlite" | "log" | "native" | "memory" (reference: lmdb|sqlite)
     metadata_fsync: bool = True
     data_fsync: bool = False
     metadata_auto_snapshot_interval: int | None = None  # msec

@@ -28,13 +28,18 @@ except Exception:
 import pytest  # noqa: E402
 
 
-@pytest.fixture(params=["memory", "sqlite", "log"])
+@pytest.fixture(params=["memory", "sqlite", "log", "native"])
 def db(request, tmp_path):
     """Multi-engine DB fixture: every db test runs against all engines —
-    two durable (sqlite, log-structured) + memory
+    three durable (sqlite, log-structured, native C++) + memory
     (reference src/db/test.rs:127-144 pattern)."""
     from garage_tpu.db import open_db
 
+    if request.param == "native":
+        from garage_tpu import _native
+
+        if not _native.available():
+            pytest.skip("native library unavailable")
     d = open_db(str(tmp_path / "db"), engine=request.param)
     yield d
     d.close()

@@ -14,20 +14,39 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench_meta
 
 
+def _with_retry(check):
+    """Perf floors on a shared 1-core box: one transient load spike (the
+    driver, a background compile) must not flake the guard — a REAL
+    regression fails both attempts."""
+    try:
+        check()
+    except AssertionError:
+        check()
+
+
 def test_db_engine_throughput_floor():
-    for engine, floor_insert, floor_get in (
-        ("sqlite", 3_000, 20_000),
-        ("log", 800, 100_000),
-    ):
-        r = bench_meta.bench_db_engine(engine, 1000)
-        assert r["insert_ops"] > floor_insert, (engine, r)
-        assert r["get_ops"] > floor_get, (engine, r)
-        assert r["tx_insert_ops"] > 10_000, (engine, r)
-        assert r["scan_keys_per_s"] > 50_000, (engine, r)
+    engines = [("sqlite", 3_000, 20_000), ("log", 800, 100_000)]
+    from garage_tpu import _native
+
+    if _native.available():
+        engines.append(("native", 800, 100_000))
+
+    def check():
+        for engine, floor_insert, floor_get in engines:
+            r = bench_meta.bench_db_engine(engine, 1000)
+            assert r["insert_ops"] > floor_insert, (engine, r)
+            assert r["get_ops"] > floor_get, (engine, r)
+            assert r["tx_insert_ops"] > 10_000, (engine, r)
+            assert r["scan_keys_per_s"] > 50_000, (engine, r)
+
+    _with_retry(check)
 
 
 def test_s3_metadata_path_floor():
-    r = asyncio.run(bench_meta.bench_s3_meta("sqlite", 120, 120))
-    assert r["inline_put_ops"] > 60, r
-    assert r["list_keys_per_s"] > 2_000, r
-    assert r["listed"] == 120
+    def check():
+        r = asyncio.run(bench_meta.bench_s3_meta("sqlite", 120, 120))
+        assert r["inline_put_ops"] > 60, r
+        assert r["list_keys_per_s"] > 2_000, r
+        assert r["listed"] == 120
+
+    _with_retry(check)
