@@ -232,13 +232,22 @@ async def offline_repair(args) -> None:
     try:
         if args.what == "tables":
             for t in garage.tables:
-                # rebuild merkle trees from scratch locally
-                n = 0
-                for key, vh in list(t.data.merkle_todo.iter_range()):
-                    t.merkle.update_item(key, vh)
-                    t.data.merkle_todo.remove(key)
-                    n += 1
-                print(f"{t.schema.table_name}: {n} merkle items")
+                # rebuild merkle trees from scratch locally, chunked into
+                # batched transactions (2 commits per 100 items, not 2
+                # commits per item — a large backlog would otherwise pay
+                # millions of journal round-trips)
+                todo = list(t.data.merkle_todo.iter_range())
+                for i in range(0, len(todo), 100):
+                    chunk = todo[i : i + 100]
+                    t.merkle.update_batch(chunk)
+                    t.data.db.transaction(
+                        lambda tx, c=chunk: [
+                            tx.remove(t.data.merkle_todo, key)
+                            for key, _vh in c
+                        ]
+                        and None
+                    )
+                print(f"{t.schema.table_name}: {len(todo)} merkle items")
         else:
             w = (
                 RepairWorker(garage.block_manager)

@@ -135,8 +135,12 @@ class SqliteDb(Db):
         self.conn = sqlite3.connect(path, check_same_thread=False)
         self.lock = threading.RLock()
         self.conn.execute("PRAGMA journal_mode = WAL")
+        # WAL + NORMAL already skips the per-commit fsync (it syncs only
+        # at checkpoints), so that is the fsync=False setting; OFF would
+        # additionally skip checkpoint syncs and can corrupt the whole DB
+        # on power loss.  fsync=True buys per-commit durability (FULL).
         self.conn.execute(
-            "PRAGMA synchronous = " + ("NORMAL" if fsync else "OFF")
+            "PRAGMA synchronous = " + ("FULL" if fsync else "NORMAL")
         )
         self.conn.execute(
             "CREATE TABLE IF NOT EXISTS _trees (name TEXT PRIMARY KEY)"

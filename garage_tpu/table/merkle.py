@@ -19,8 +19,9 @@ trees): a prefix holding 0 keys stores nothing, 1 key stores a leaf,
 node_hash = blake2(msgpack(node)); parent references child by hash so any
 difference propagates to the root — two replicas with equal roots hold
 bit-identical partitions.  The MerkleWorker consumes `merkle_todo`
-(key -> new value hash, b"" = deleted) and updates leaf + path in one
-transaction per item.
+(key -> new value hash, b"" = deleted) in batches: up to 100 items are
+applied in one transaction, then their todos cleared (supersession-
+checked) in a second — per-commit cost, not the trie walk, dominates.
 """
 
 from __future__ import annotations
@@ -77,11 +78,18 @@ class MerkleUpdater:
 
     def update_item(self, key: bytes, value_hash: bytes) -> None:
         """Apply one merkle_todo item (value_hash = b'' means deleted)."""
-        partition = self.data.replication.partition_of(key[:32])
+        self.update_batch([(key, value_hash)])
+
+    def update_batch(self, items: list[tuple[bytes, bytes]]) -> None:
+        """Apply a batch of todo items in ONE transaction: the per-commit
+        cost (sqlite journal round-trip, native/log WAL frame + fsync)
+        dominates the trie walk, so draining 100 items per commit instead
+        of one is a ~100x cut in commit overhead under write load."""
 
         def txf(tx: Tx):
-            # recheck todo under tx (a newer update may have superseded it)
-            self._update_rec(tx, partition, b"", key, value_hash or None)
+            for key, value_hash in items:
+                partition = self.data.replication.partition_of(key[:32])
+                self._update_rec(tx, partition, b"", key, value_hash or None)
             return None
 
         self.data.db.transaction(txf)
@@ -166,14 +174,21 @@ class MerkleWorker(Worker):
         return {"todo": len(self.data.merkle_todo)}
 
     async def work(self) -> WorkerState:
-        n = 0
+        batch: list[tuple[bytes, bytes]] = []
         for key, vhash in self.data.merkle_todo.iter_range():
-            self.updater.update_item(key, vhash)
-            # only clear the todo if it wasn't superseded meanwhile
-            cur = self.data.merkle_todo.get(key)
-            if cur == vhash:
-                self.data.merkle_todo.remove(key)
-            n += 1
-            if n >= 100:
+            batch.append((key, vhash))
+            if len(batch) >= 100:
                 break
-        return WorkerState.BUSY if n else WorkerState.IDLE
+        if not batch:
+            return WorkerState.IDLE
+        self.updater.update_batch(batch)
+        todo = self.data.merkle_todo
+
+        def clear(tx):
+            # only clear todos that weren't superseded while we applied
+            for key, vhash in batch:
+                if tx.get(todo, key) == vhash:
+                    tx.remove(todo, key)
+
+        self.data.db.transaction(clear)
+        return WorkerState.BUSY

@@ -100,7 +100,14 @@ class Config:
     data_dir: list[DataDir] = field(default_factory=list)
 
     db_engine: str = "sqlite"  # "sqlite" | "log" | "native" | "memory" (reference: lmdb|sqlite)
-    metadata_fsync: bool = True
+    # disabled by default like the reference (src/util/config.rs:19-21
+    # "Whether to fsync after all metadata transactions (disabled by
+    # default)"): a process crash can't lose committed metadata (the page
+    # cache survives), only a host crash can — and quorum replication is
+    # the durability story there.  Engine mapping: log/native skip the
+    # per-commit fdatasync; sqlite runs WAL+synchronous=NORMAL (sync at
+    # checkpoints only) vs FULL when true.
+    metadata_fsync: bool = False
     data_fsync: bool = False
     metadata_auto_snapshot_interval: int | None = None  # msec
     metadata_snapshots_dir: str | None = None  # default <metadata_dir>/snapshots
