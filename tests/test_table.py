@@ -121,6 +121,72 @@ def test_merkle_prefix_keys(tmp_path):
     assert mu.root_hash(5) == r  # order independent with prefix keys
 
 
+def test_merkle_batch_equivalence(tmp_path):
+    """Batched application must produce BIT-IDENTICAL trees (every node,
+    not just the root) to per-item application, for any batch
+    partitioning and order — a mixed-version cluster's sync depends on
+    it.  Includes shared long prefixes (the real workload: one bucket's
+    keys share their 32-byte partition hash), strict-prefix keys (term
+    slots), overwrites, and deletes."""
+    rng = random.Random(11)
+    shared = bytes([7]) + b"\xaa" * 31  # deep single-child chain
+    items = [(shared + rng.randbytes(rng.randint(0, 5)), rng.randbytes(8))
+             for _ in range(60)]
+    items += [(bytes([7]) + rng.randbytes(3), rng.randbytes(8)) for _ in range(20)]
+    items = list({k: v for k, v in items}.items())
+    deletes = [(k, b"") for k, _ in rng.sample(items, 25)]
+    rewrites = [(k, rng.randbytes(8)) for k, _ in rng.sample(items, 10)]
+    workload = items + deletes + rewrites
+
+    def tree_contents(d):
+        return dict(d.merkle_tree.iter_range())
+
+    # reference: one item per batch, in order
+    d_ref = mk_data(tmp_path, "ref")
+    mu_ref = MerkleUpdater(d_ref)
+    for k, vh in workload:
+        mu_ref.update_item(k, vh)
+    ref = tree_contents(d_ref)
+    assert ref, "workload produced an empty tree?"
+
+    # one giant batch — NOTE: order within the workload matters for the
+    # final value of rewritten keys, so order is preserved, only the
+    # batching changes
+    d_one = mk_data(tmp_path, "one")
+    mu_one = MerkleUpdater(d_one)
+    mu_one.update_batch(workload)
+    assert tree_contents(d_one) == ref
+
+    # random batch sizes
+    d_rb = mk_data(tmp_path, "rb")
+    mu_rb = MerkleUpdater(d_rb)
+    i = 0
+    while i < len(workload):
+        n = rng.randint(1, 17)
+        mu_rb.update_batch(workload[i : i + n])
+        i += n
+    assert tree_contents(d_rb) == ref
+
+
+def test_merkle_noop_deletes(tmp_path):
+    """Deletes of keys the trie never saw (a PUT superseded by DELETE in
+    merkle_todo before the worker ran) must neither crash the batch
+    flush nor rewrite any node."""
+    d = mk_data(tmp_path)
+    mu = MerkleUpdater(d)
+    mu.update_batch([(b"\x01Ax", b"h1"), (b"\x01B", b"h2")])
+    before = dict(d.merkle_tree.iter_range())
+
+    # absent sibling under an existing leaf (the flush-crash case), an
+    # absent subtree, and an idempotent re-apply — none may change bytes
+    mu.update_batch([(b"\x01Ay", b""), (b"\x01Cz", b""), (b"\x01B", b"h2")])
+    assert dict(d.merkle_tree.iter_range()) == before
+
+    # mixed batch: no-ops + one real change still applies the change
+    mu.update_batch([(b"\x01Qq", b""), (b"\x01B", b"h3")])
+    assert dict(d.merkle_tree.iter_range()) != before
+
+
 # --- cluster tests -----------------------------------------------------------
 
 
