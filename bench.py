@@ -17,13 +17,25 @@ Flags: --batch (blocks per dispatch), --iters, --hash (also compute BLAKE3
 shard hashes in the same dispatch), --repair (measure reconstruction of m
 lost shards instead of encode).
 
-Wedge-proofing (round-1 failure mode: the tunneled TPU backend can wedge a
-process forever, even during PJRT init, and an in-process watchdog thread
-cannot unwedge it).  The parent process NEVER imports jax: it runs the
-measurement in a subprocess with a hard kill.  If the default-backend child
-times out or dies, it retries in a fresh subprocess with JAX_PLATFORMS=cpu
-(so the wedged plugin is never even initialized) and scaled-down shapes.
-The driver therefore always gets a JSON line.
+Wedge-proofing, round-4 design (the tunneled TPU backend can wedge a
+process forever, even during PJRT init; an in-process watchdog cannot
+unwedge it; and rounds 1-3 showed a single 360 s do-everything child banks
+NOTHING when any stage of it wedges).  The parent never imports jax and
+runs a LADDER of short, independently-killable children:
+
+  1. probe (60 s): init the backend, one tiny matmul + host fetch.
+     Wedged tunnel -> dies here, 60 s spent, straight to CPU fallback.
+  2. quick dial (150 s): small-batch measurement on the einsum path
+     (plain XLA, no Mosaic remote-compile exposure) -> banks a first
+     "platform": "tpu" line.
+  3. flagship dial (240 s): full-batch fused Pallas kernel -> upgrades
+     the banked number.  If it wedges, the step-2 number still stands.
+
+Children enable the persistent XLA compilation cache (committed
+`.xla_cache/` dir), so any process that finds a healthy window spends its
+budget executing, not compiling — and pre-warms the cache for the next.
+Every attempt (cmd, rc, stdout, stderr, UTC timestamps) is appended to
+`tpu_runs/bench_<ts>.log` so on-chip claims are auditable after the fact.
 """
 
 import argparse
@@ -33,8 +45,12 @@ import subprocess
 import sys
 import time
 
-TPU_TIMEOUT = 360.0
+PROBE_TIMEOUT = 60.0
+QUICK_TIMEOUT = 150.0
+FLAGSHIP_TIMEOUT = 240.0
 CPU_TIMEOUT = 270.0
+
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def parse_args(argv):
@@ -57,15 +73,39 @@ def parse_args(argv):
     ap.add_argument("--impl", choices=["pallas_int8", "pallas_bf16", "einsum"],
                     default=None, help="pin the EC kernel implementation")
     ap.add_argument("--verbose", action="store_true")
+    ap.add_argument("--no-ladder", action="store_true",
+                    help="single child on the default backend (old behavior)")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.hash and args.repair:
         ap.error("--hash and --repair are mutually exclusive")
     return args
 
 
+def probe_main() -> None:
+    """Tiny backend liveness check — the 60 s canary for the ladder."""
+    from garage_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    np.asarray(y[:1, :1])  # honest host-fetch barrier
+    print(json.dumps({"probe": "ok", "platform": dev.platform,
+                      "device": str(dev)}))
+
+
 def child_main(args) -> None:
     """Measurement body — runs in a subprocess the parent can hard-kill."""
+    from garage_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     import numpy as np
 
     import jax
@@ -166,6 +206,8 @@ def child_main(args) -> None:
     bytes_per_iter = args.batch * k * shard_bytes  # data bytes coded
     gbps = bytes_per_iter * args.iters / dt / 1e9
     metric = "ec%d%d_%s_GBps" % (k, m, "repair" if args.repair else "encode")
+    if args.hash:
+        metric = "ec%d%d_encode_hash_GBps" % (k, m)
     print(
         json.dumps(
             {
@@ -174,73 +216,153 @@ def child_main(args) -> None:
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / 10.0, 4),
                 "platform": dev.platform,
+                "batch": args.batch,
             }
         )
     )
 
 
-def run_child(argv, env, timeout):
-    """Run the measurement subprocess; return its JSON line or None."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--_child", *argv]
+class Transcript:
+    """Appends every child attempt to tpu_runs/bench_<ts>.log (auditable
+    raw record of on-chip runs — VERDICT r3 Weak #2)."""
+
+    def __init__(self):
+        ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+        d = os.path.join(REPO, "tpu_runs")
+        os.makedirs(d, exist_ok=True)
+        self.path = os.path.join(d, f"bench_{ts}.log")
+        self._write(f"# bench.py ladder transcript — started {ts}Z\n"
+                    f"# argv: {sys.argv[1:]}\n")
+
+    def _write(self, s):
+        with open(self.path, "a") as f:
+            f.write(s)
+
+    def record(self, stage, cmd, rc, out, err, dt):
+        now = time.strftime("%H:%M:%S", time.gmtime())
+        self._write(
+            f"\n== {stage} @ {now}Z rc={rc} dt={dt:.1f}s\n"
+            f"$ {' '.join(cmd)}\n"
+            + "".join(f"O| {l}\n" for l in (out or "").splitlines())
+            + "".join(f"E| {l}\n" for l in (err or "").splitlines())
+        )
+
+
+def run_logged(cmd, timeout, env=None, cwd=REPO):
+    """Subprocess with a hard timeout.  Returns (rc, stdout, stderr, dt);
+    rc is "TIMEOUT" on expiry (partial output preserved).  Shared with
+    script/tpu_bank.py so the wedge-handling exists exactly once."""
+    t0 = time.time()
     try:
         proc = subprocess.run(
-            cmd,
-            env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            timeout=timeout,
-            capture_output=True,
-            text=True,
+            cmd, env=env, cwd=cwd, timeout=timeout,
+            capture_output=True, text=True,
         )
-    except subprocess.TimeoutExpired:
-        print("# bench child timed out (backend wedged?)", file=sys.stderr)
-        return None
-    sys.stderr.write(proc.stderr)
-    for line in proc.stdout.splitlines():
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = "TIMEOUT"
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+    return rc, out, err, time.time() - t0
+
+
+def json_lines(text):
+    """Every parseable {...} line in `text`, in order."""
+    res = []
+    for line in (text or "").splitlines():
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                res.append(json.loads(line))
             except json.JSONDecodeError:
                 continue
-    print(f"# bench child rc={proc.returncode}, no JSON line", file=sys.stderr)
+    return res
+
+
+def run_child(argv, env, timeout, transcript=None, stage=""):
+    """Run a measurement subprocess; return its JSON line or None."""
+    cmd = [sys.executable, os.path.abspath(__file__), *argv]
+    rc, out, err, dt = run_logged(cmd, timeout, env=env)
+    if transcript:
+        transcript.record(stage or "child", cmd, rc, out, err, dt)
+    if rc == "TIMEOUT":
+        print(f"# bench {stage or 'child'} timed out after {timeout:.0f}s "
+              "(backend wedged?)", file=sys.stderr)
+        return None
+    sys.stderr.write(err)
+    lines = json_lines(out)
+    if lines:
+        return lines[0]
+    print(f"# bench {stage or 'child'} rc={rc}, no JSON line", file=sys.stderr)
     return None
+
+
+def cpu_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the sitecustomize dials the TPU tunnel at interpreter startup
+    # when this is set — scrub it so the CPU child can never block
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
 
 
 def main() -> None:
     argv = sys.argv[1:]
     args = parse_args(argv)
+    if args._probe:
+        probe_main()
+        return
     if args._child:
         child_main(args)
         return
 
-    # Attempt 1: default backend (the real chip when the tunnel is healthy).
-    result = run_child(argv, dict(os.environ), TPU_TIMEOUT)
+    tr = Transcript()
+    env = dict(os.environ)
+    result = None
+    argv = [a for a in argv if a != "--no-ladder"]
+
+    # Step 1: 60 s canary.  A wedged tunnel dies here, not at 360 s.
+    probe = run_child(["--_probe"], env, PROBE_TIMEOUT, tr, "probe")
+    tpu_ok = bool(probe) and probe.get("platform") not in (None, "cpu")
+
+    if tpu_ok and not args.no_ladder:
+        # Step 2: bank a first TPU number on the lowest-risk path.
+        # (Skipped when the user pinned impl/batch — they asked for one dial.)
+        if args.impl is None and args.batch is None and not args.hash:
+            quick_argv = ["--_child", *argv, "--impl", "einsum",
+                          "--batch", "64", "--iters", "10"]
+            result = run_child(quick_argv, env, QUICK_TIMEOUT, tr, "quick-einsum")
+            if result and result.get("platform") == "cpu":
+                result = None  # don't let a mis-routed child masquerade as tpu
+
+        # Step 3: flagship fused-Pallas dial; upgrades the banked number.
+        flag = run_child(["--_child", *argv], env, FLAGSHIP_TIMEOUT, tr, "flagship")
+        if flag and flag.get("platform") != "cpu":
+            if result is None or flag.get("value", 0) >= result.get("value", 0):
+                result = flag
+    elif tpu_ok:
+        result = run_child(["--_child", *argv], env, FLAGSHIP_TIMEOUT, tr, "single")
+        if result and result.get("platform") == "cpu":
+            pass  # user forced something odd; keep it
 
     if result is None:
-        # Attempt 2: forced CPU in a fresh process — the wedged plugin is
-        # never initialized.  Scale shapes down unless the user pinned them.
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        # the sitecustomize dials the TPU tunnel at interpreter startup
-        # when this is set — scrub it so the CPU child can never block
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        cpu_argv = list(argv)
-        if "--batch" not in " ".join(argv):
+        # CPU fallback in a fresh process — the wedged plugin is never
+        # even initialized.  Scale shapes down unless the user pinned them.
+        cpu_argv = ["--_child", *argv]
+        if args.batch is None:
             cpu_argv += ["--batch", "8"]
         if "--iters" not in " ".join(argv):
             # long enough that scheduler noise on the 1-CPU box doesn't
             # dominate (5 iters = ~80 ms of work; 40 = ~1.5 s)
             cpu_argv += ["--iters", "40"]
         print("# default backend unusable; falling back to cpu", file=sys.stderr)
-        result = run_child(cpu_argv, env, CPU_TIMEOUT)
+        result = run_child(cpu_argv, cpu_env(), CPU_TIMEOUT, tr, "cpu-fallback")
 
     if result is None:
         # Last resort: still emit a parseable line; value 0 = failed run.
-        metric = "ec%d%d_%s_GBps" % (
-            args.k,
-            args.m,
-            "repair" if args.repair else "encode",
-        )
+        dial = "repair" if args.repair else (
+            "encode_hash" if args.hash else "encode")
+        metric = "ec%d%d_%s_GBps" % (args.k, args.m, dial)
         result = {
             "metric": metric,
             "value": 0.0,

@@ -97,6 +97,34 @@ bool write_all(int fd, const void* buf, size_t len) {
   return true;
 }
 
+// Structural check of one frame payload WITHOUT mutating state.  Commit
+// validates before writing/applying so a malformed batch can never leave
+// memory and disk divergent (a partial apply would make this process see
+// keys the post-restart replay silently drops).
+bool validate_payload(const uint8_t* p, size_t len) {
+  size_t pos = 0;
+  while (pos < len) {
+    if (pos + 3 > len) return false;
+    uint8_t op = p[pos];
+    uint16_t tlen;
+    std::memcpy(&tlen, p + pos + 1, 2);
+    pos += 3 + tlen;
+    if (pos + 4 > len) return false;
+    uint32_t klen = rd_u32(p + pos);
+    pos += 4 + klen;
+    if (pos > len) return false;
+    if (op == kOpPut) {
+      if (pos + 4 > len) return false;
+      uint32_t vlen = rd_u32(p + pos);
+      pos += 4 + vlen;
+      if (pos > len) return false;
+    } else if (op != kOpDel) {
+      return false;
+    }
+  }
+  return true;
+}
+
 // Apply one frame payload to the in-memory state.  Returns false on a
 // malformed record (treated like a corrupt frame by the replay caller).
 bool apply_payload(KvDb* db, const uint8_t* p, size_t len) {
@@ -208,15 +236,28 @@ int compact(KvDb* db) {
     }
     total += frame.size();
   }
-  if (::fsync(tfd) != 0 || ::close(tfd) != 0) {
+  int frc = ::fsync(tfd);
+  int crc = ::close(tfd);  // close unconditionally: no fd leak on fsync fail
+  if (frc != 0 || crc != 0) {
+    ::unlink(tmp.c_str());
+    return -1;
+  }
+  // Open the append fd to the NEW inode BEFORE the rename: the fd stays
+  // valid across rename (same inode), so there is no window where db->fd
+  // is closed/-1 and a failure can strand the handle.  Every early return
+  // below leaves db->fd and the old log fully intact (true best-effort).
+  int nfd = ::open(tmp.c_str(), O_WRONLY | O_APPEND, 0644);
+  if (nfd < 0) {
+    ::unlink(tmp.c_str());
+    return -1;
+  }
+  if (::rename(tmp.c_str(), db->path.c_str()) != 0) {
+    ::close(nfd);
     ::unlink(tmp.c_str());
     return -1;
   }
   if (db->fd >= 0) ::close(db->fd);
-  db->fd = -1;
-  if (::rename(tmp.c_str(), db->path.c_str()) != 0) return -1;
-  db->fd = ::open(db->path.c_str(), O_WRONLY | O_APPEND, 0644);
-  if (db->fd < 0) return -1;
+  db->fd = nfd;
   db->log_bytes = total;
   return 0;
 }
@@ -259,6 +300,10 @@ int kv_close(void* h) {
 // applies to memory, maybe compacts.
 int kv_commit(void* h, const uint8_t* payload, size_t len) {
   KvDb* db = static_cast<KvDb*>(h);
+  // Validate BEFORE writing or applying: a malformed batch is rejected
+  // with no disk write and no memory mutation, so the -2 path can never
+  // leave an acked-in-memory key that a post-restart replay would drop.
+  if (!validate_payload(payload, len)) return -2;
   std::string frame;
   frame.reserve(len + 8);
   put_u32(frame, static_cast<uint32_t>(len));
@@ -274,8 +319,14 @@ int kv_commit(void* h, const uint8_t* payload, size_t len) {
     ::ftruncate(db->fd, static_cast<off_t>(db->log_bytes));
     return -1;
   }
+  if (!apply_payload(db, payload, len)) {
+    // Unreachable after the validate above (apply's structural checks
+    // are a subset) — kept as a belt-and-braces guard: roll the frame
+    // off the file so replay never stops at it.
+    ::ftruncate(db->fd, static_cast<off_t>(db->log_bytes));
+    return -2;
+  }
   db->log_bytes += frame.size();
-  if (!apply_payload(db, payload, len)) return -2;  // malformed batch
   maybe_compact(db);
   return 0;
 }

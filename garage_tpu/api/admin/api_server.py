@@ -208,6 +208,52 @@ class AdminApiServer:
                 }
             )
 
+        if path == "/v1/health" and request.method == "GET":
+            # GetClusterHealth: standalone health JSON resource (reference
+            # router_v1.rs:102, cluster.rs ClusterHealth struct) — same
+            # payload /health serves LBs, but authenticated + always 200
+            # so operators can read the *reason* a cluster is unavailable.
+            return web.json_response(g.system.health().__dict__)
+
+        if path == "/v1/connect" and request.method == "POST":
+            # ConnectClusterNodes (reference router_v1.rs:103,
+            # cluster.rs:139-161): body = JSON array of "id@host:port";
+            # response = per-node [{success, error}] in request order.
+            body = await request.json()
+            if not isinstance(body, list):
+                return web.Response(status=400, text="expected a JSON array")
+            results = []
+            for node in body:
+                try:
+                    nid_hex, _, addr = str(node).partition("@")
+                    host, _, port = addr.rpartition(":")
+                    if not (nid_hex and host and port):
+                        raise ValueError(f"malformed node address {node!r}")
+                    await g.netapp.connect(
+                        (host, int(port)), bytes.fromhex(nid_hex)
+                    )
+                    results.append({"success": True, "error": None})
+                except Exception as e:  # noqa: BLE001 — per-node report
+                    results.append({"success": False, "error": str(e)})
+            return web.json_response(results)
+
+        if path == "/v1/node" and request.method == "GET":
+            # GetNodeInfo: the node answering the request (not the
+            # cluster): identity, version, engine, data/metadata dirs.
+            import sys as _sys
+
+            return web.json_response(
+                {
+                    "nodeId": hex_of(g.node_id),
+                    "garageVersion": "garage-tpu/0.1.0",
+                    "garageFeatures": ["k2v", "erasure-coding", "tpu"],
+                    "pythonVersion": _sys.version.split()[0],
+                    "dbEngine": g.config.db_engine,
+                    "metadataDir": g.config.metadata_dir,
+                    "dataDirs": [d.path for d in g.config.data_dir],
+                }
+            )
+
         if path == "/v1/layout":
             if request.method == "GET":
                 lay = g.layout_manager.history
@@ -250,6 +296,9 @@ class AdminApiServer:
         if path == "/v1/layout/apply" and request.method == "POST":
             body = await request.json() if request.can_read_body else {}
             lv, report = g.layout_manager.apply_staged(body.get("version"))
+            warn = g.ec_layout_warning(lv)
+            if warn:
+                report = list(report) + [warn]
             return web.json_response({"version": lv.version, "report": report})
         if path == "/v1/layout/revert" and request.method == "POST":
             g.layout_manager.revert_staged()

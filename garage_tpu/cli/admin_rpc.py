@@ -95,6 +95,9 @@ class AdminRpcHandler:
 
     async def op_layout_apply(self, args) -> Any:
         lv, report = self.garage.layout_manager.apply_staged(args.get("version"))
+        warn = self.garage.ec_layout_warning(lv)
+        if warn:
+            report = list(report) + [warn]
         return {"version": lv.version, "report": report}
 
     async def op_layout_revert(self, args) -> Any:

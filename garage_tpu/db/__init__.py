@@ -63,7 +63,17 @@ class Tree:
         end: bytes | None = None,
         reverse: bool = False,
     ) -> Iterator[tuple[bytes, bytes]]:
-        """Iterate (k, v) with start <= k < end (end exclusive), ordered."""
+        """Iterate (k, v) with start <= k < end (end exclusive), ordered.
+
+        Consistency contract (the WEAKEST the engines provide, so callers
+        must assume it): keys inserted/deleted by OTHER transactions while
+        the iterator is live MAY or MAY NOT be observed — the log engine
+        snapshots the key range up front, the native engine pages through
+        the live map in chunks, sqlite depends on statement caching.  A
+        caller that mutates ahead of its own cursor (merkle/GC workers
+        queue work instead) must not rely on seeing — or not seeing —
+        those keys.  Pinned by tests/test_db.py
+        test_iter_range_mid_iteration_contract."""
         raise NotImplementedError
 
     def iter_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:

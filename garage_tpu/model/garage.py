@@ -276,6 +276,29 @@ class Garage:
         self.bg = BackgroundRunner()
         self._started = False
 
+    def ec_layout_warning(self, lv) -> str | None:
+        """EC(k,m) places k+m distinct pieces per block, so every active
+        layout version needs >= k+m storage nodes; an applied version
+        below that makes EC PUTs error until a wider layout lands (reads
+        and repair of existing blocks keep working — any k surviving
+        pieces decode).  Returns an operator warning string, or None.
+        See doc/ec-placement.md §"Shrinking below k+m"; reference
+        philosophy: src/rpc/layout/version.rs:177-249 invariant checks."""
+        npieces = self.block_manager.codec.n_pieces
+        if npieces <= 1:
+            return None
+        storage = [n for n, r in lv.roles.items() if r.capacity]
+        if len(storage) >= npieces:
+            return None
+        k = self.block_manager.codec.min_pieces
+        return (
+            f"WARNING: layout v{lv.version} has {len(storage)} storage "
+            f"node(s) but EC({k},{npieces - k}) needs {npieces} per block; "
+            f"EC writes will FAIL until a layout with >= {npieces} storage "
+            "nodes is applied (existing blocks stay readable/repairable "
+            "from any surviving k pieces)"
+        )
+
     # --- lifecycle -----------------------------------------------------------
 
     async def start(self) -> None:

@@ -39,7 +39,11 @@ class ScrubRepairPipeline:
 
     def encode_and_hash_fn(self):
         """Jittable fn: data (B, k, S) uint8 -> (parity (B, m, S),
-        hashes (B, k+m, 32), scrub_stats (2,))."""
+        hashes (B, k+m, 32), scrub_stats (2,)).
+
+        One fused body serves both the single-device and the mesh step:
+        `nvalid` masks zero-pad blocks out of the scrub statistics (the
+        single-device wrapper passes the full batch)."""
         import jax.numpy as jnp
 
         from ..ops.ec_tpu import gf_bitmatmul
@@ -48,7 +52,7 @@ class ScrubRepairPipeline:
         enc_bitmat = jnp.asarray(self._enc_bitmat_np, dtype=jnp.bfloat16)
         hash_fn = blake3_batch_fn(s)
 
-        def fwd(data):
+        def fwd(data, nvalid=None):
             b = data.shape[0]
             parity = gf_bitmatmul(enc_bitmat, data)
             shards = jnp.concatenate([data, parity], axis=1)  # (B, k+m, S)
@@ -60,10 +64,16 @@ class ScrubRepairPipeline:
             hw = hashes.reshape(b, (k + m) * 8, 4).astype(jnp.uint32)
             hwords = hw[..., 0] | (hw[..., 1] << 8) | (hw[..., 2] << 16) | (hw[..., 3] << 24)
             bitpos = jnp.arange(32, dtype=jnp.uint32)
-            hbits = (hwords[..., None] >> bitpos) & 1  # (B, W, 32)
-            parities = hbits.astype(jnp.int32).sum(axis=(0, 1)) & 1  # (32,)
+            hbits = ((hwords[..., None] >> bitpos) & 1).astype(jnp.int32)  # (B,W,32)
+            if nvalid is None:
+                count = jnp.uint32(b)
+            else:
+                valid = (jnp.arange(b) < nvalid).astype(jnp.int32)  # (B,)
+                hbits = hbits * valid[:, None, None]
+                count = nvalid.astype(jnp.uint32)
+            parities = hbits.sum(axis=(0, 1)) & 1  # (32,)
             fold = (parities.astype(jnp.uint32) << bitpos).sum(dtype=jnp.uint32)
-            stats = jnp.stack([jnp.uint32(b), fold])
+            stats = jnp.stack([count, fold])
             return parity, hashes, stats
 
         return fwd
@@ -86,9 +96,13 @@ class ScrubRepairPipeline:
     def sharded_step(self, mesh):
         """The full multi-chip repair/scrub step jitted over `mesh`:
         block-batch sharded over the "blocks" axis, coding matrices
-        replicated, scrub stats psum-reduced across the mesh."""
+        replicated, scrub stats psum-reduced across the mesh.
+
+        The step takes (data, nvalid): explicit shardings require the batch
+        to divide the mesh, so uneven batches arrive zero-padded (see
+        `sharded_apply`) and `nvalid` masks the pad blocks out of the scrub
+        statistics (their parity/hash rows are sliced off host-side)."""
         import jax
-        import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         fwd = self.encode_and_hash_fn()
@@ -99,10 +113,41 @@ class ScrubRepairPipeline:
             NamedSharding(mesh, P()),
         )
 
-        def step(data):
-            parity, hashes, stats = fwd(data)
-            return parity, hashes, stats
-
         return jax.jit(
-            step, in_shardings=(data_sharding,), out_shardings=out_shardings
+            fwd,
+            in_shardings=(data_sharding, NamedSharding(mesh, P())),
+            out_shardings=out_shardings,
+        )
+
+    def sharded_apply(self, mesh, data: np.ndarray):
+        """Host entry for the mesh step with ANY batch size: zero-pads the
+        block batch up to a multiple of the mesh, runs the sharded step,
+        slices the pad rows back off.  Returns (parity, hashes, stats) as
+        numpy, stats covering only the real blocks."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("sharded", id(mesh))
+        if key not in self._fns:
+            self._fns[key] = self.sharded_step(mesh)
+        step = self._fns[key]
+
+        n = mesh.devices.size
+        b = data.shape[0]
+        pad = (-b) % n
+        if pad:
+            data = np.concatenate(
+                [data, np.zeros((pad, *data.shape[1:]), np.uint8)]
+            )
+        data_dev = jax.device_put(
+            jnp.asarray(data), NamedSharding(mesh, P("blocks"))
+        )
+        parity, hashes, stats = jax.block_until_ready(
+            step(data_dev, jnp.uint32(b))
+        )
+        return (
+            np.asarray(parity)[:b],
+            np.asarray(hashes)[:b],
+            np.asarray(stats),
         )

@@ -163,15 +163,11 @@ def gf_bitmatmul_pallas(bitmat, x, *, dot_dtype: str = "int8", interpret: bool =
 
 # --- dispatch ---------------------------------------------------------------
 
-@functools.lru_cache(maxsize=None)
-def ec_apply_fn(platform: str | None = None, impl: str | None = None):
-    """Jitted `fn(bitmat_uint8, x_uint8) -> out_uint8`, cached per
-    (platform, impl).  impl: None = auto (Pallas on TPU, einsum elsewhere),
-    or one of "einsum" / "pallas_int8" / "pallas_bf16"."""
-    jax = _jax()
+def _ec_body(plat: str, impl: str | None):
+    """Unjitted coding body for (resolved platform, impl).  impl: None =
+    auto (Pallas on TPU, einsum elsewhere)."""
     import jax.numpy as jnp
 
-    plat = platform or jax.default_backend()
     if impl is None:
         impl = "pallas_int8" if plat not in ("cpu",) else "einsum"
 
@@ -188,9 +184,48 @@ def ec_apply_fn(platform: str | None = None, impl: str | None = None):
             return gf_bitmatmul_pallas(bitmat, x, dot_dtype=dd, interpret=interp)
     else:
         raise ValueError(f"unknown impl {impl!r}")
+    return body
 
+
+@functools.lru_cache(maxsize=None)
+def ec_apply_fn(platform: str | None = None, impl: str | None = None):
+    """Jitted `fn(bitmat_uint8, x_uint8) -> out_uint8`, cached per
+    (platform, impl).  impl: None = auto (Pallas on TPU, einsum elsewhere),
+    or one of "einsum" / "pallas_int8" / "pallas_bf16"."""
+    jax = _jax()
+
+    plat = platform or jax.default_backend()
+    body = _ec_body(plat, impl)
     kwargs = {"backend": platform} if platform else {}
     return jax.jit(body, **kwargs)
+
+
+@functools.lru_cache(maxsize=None)
+def ec_apply_fn_mesh(
+    platform: str | None, impl: str | None, n_devices: int, axis: str = "blocks"
+):
+    """(jitted_fn, mesh): the coding body shard_map-ed over an n-device 1-D
+    mesh — block batch split across devices, coding matrix replicated, no
+    collectives (embarrassingly parallel).  `shard_map` (not GSPMD
+    auto-partitioning) because the Pallas kernel is opaque to GSPMD: each
+    device runs its own pallas_call on its local batch slice.
+
+    This is the pod-level repair fan-out path (BASELINE.md staged config
+    row 5): `EcCodec.{encode,reconstruct}_batch` route here whenever >1
+    device is visible, so `block/manager.bulk_reconstruct` — the real
+    storage-side repair driver — scales across a v5e pod with no changes."""
+    jax = _jax()
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import make_mesh
+
+    mesh = make_mesh(n_devices, axis=axis)
+    plat = platform or jax.default_backend()
+    body = _ec_body(plat, impl)
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis)
+    )
+    return jax.jit(fn), mesh
 
 
 # legacy alias used by the fused pipeline (portable einsum body)
@@ -212,12 +247,35 @@ class EcTpu:
     einsum path if the Pallas lowering is unavailable.
     """
 
-    def __init__(self, k: int, m: int, platform: str | None = None):
+    def __init__(
+        self, k: int, m: int, platform: str | None = None,
+        n_devices: int | None = None,
+    ):
         self.k, self.m = k, m
         self.platform = platform
         self._impl: str | None = None  # auto until first failure
+        # Pod-level fan-out: shard the block batch over every visible device
+        # (v5e-8 = 8-chip mesh) whenever there is more than one and the
+        # batch is big enough to feed them.  n_devices pins the mesh width;
+        # GARAGE_EC_MESH=0 disables (single-device dispatch).
+        self._n_dev = n_devices
+        self._mesh_warned = False
         self._enc_bitmat = self._to_dev(gf.bitmatrix_of(gf.cauchy_parity_matrix(k, m)))
         self._recon_cache: dict[tuple[tuple[int, ...], tuple[int, ...]], object] = {}
+
+    def _mesh_width(self) -> int:
+        import os
+
+        if os.environ.get("GARAGE_EC_MESH", "1") == "0":
+            return 1
+        if self._n_dev is not None:
+            return self._n_dev
+        jax = _jax()
+        try:
+            devs = jax.devices(self.platform) if self.platform else jax.devices()
+        except RuntimeError:
+            return 1
+        return len(devs)
 
     def _to_dev(self, bitmat_np: np.ndarray):
         import jax.numpy as jnp
@@ -229,6 +287,24 @@ class EcTpu:
         return arr
 
     def _apply(self, bitmat, x: np.ndarray) -> np.ndarray:
+        n = self._mesh_width()
+        # auto-detected meshes only engage once every device gets >=2
+        # blocks; an explicitly pinned width engages as soon as padding
+        # wastes less than half the mesh
+        min_batch = 2 * n if self._n_dev is None else n
+        if n > 1 and x.shape[0] >= min_batch:
+            try:
+                return self._apply_mesh(bitmat, x, n)
+            except Exception as e:  # noqa: BLE001 — mesh path optional
+                if not self._mesh_warned:
+                    self._mesh_warned = True
+                    import logging
+
+                    logging.getLogger("garage.ops.ec").warning(
+                        "mesh fan-out over %d devices failed (%r); "
+                        "repair batches fall back to single-device "
+                        "dispatch", n, e,
+                    )
         try:
             fn = ec_apply_fn(self.platform, self._impl)
             return np.asarray(fn(bitmat, x))
@@ -239,6 +315,24 @@ class EcTpu:
             self._impl = "einsum"
             fn = ec_apply_fn(self.platform, self._impl)
             return np.asarray(fn(bitmat, x))
+
+    def _apply_mesh(self, bitmat, x: np.ndarray, n: int) -> np.ndarray:
+        """Shard the block batch over the n-device mesh (pad to a multiple
+        of n with zero blocks, slice the result back)."""
+        jax = _jax()
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        b = x.shape[0]
+        pad = (-b) % n
+        if pad:
+            x = np.concatenate(
+                [np.asarray(x), np.zeros((pad, *x.shape[1:]), np.uint8)]
+            )
+        fn, mesh = ec_apply_fn_mesh(self.platform, self._impl, n)
+        xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("blocks")))
+        out = np.asarray(fn(bitmat, xd))
+        return out[:b] if pad else out
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(B, k, S) data shards -> (B, m, S) parity shards."""

@@ -1,0 +1,59 @@
+"""Persistent XLA compilation cache (round-4, VERDICT.md Missing #1).
+
+Every fresh bench/verify process used to pay the full Pallas/Mosaic compile
+inside its kill budget — the round-3 `--hash 2048` dial died exactly there.
+This module points JAX's persistent compilation cache at a committed-path
+directory inside the repo, so:
+
+- the FIRST healthy tunnel window pays compile once and writes the cache;
+- every later process (including the driver's bench run) loads the compiled
+  executable in milliseconds and spends its budget *executing*.
+
+Cache entries are keyed by jax version + backend fingerprint + HLO, so they
+are valid across processes on the same box/chip — exactly the driver's
+situation.  Entries are committed to git like the `_native/*.so` compile
+caches: stale entries are simply misses, never wrong results.
+
+Reference analog: none (the reference is interpreted Rust; its hot loops
+don't have a compile step).  This is TPU-operational plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".xla_cache")
+
+_enabled = False
+
+
+def enable_persistent_cache(path: str | None = None) -> str:
+    """Idempotently enable the persistent compilation cache.
+
+    Must be called before (or after — jax.config is live) the first jit
+    compile to have effect on it.  Returns the cache dir in use.
+    """
+    global _enabled
+    path = path or os.environ.get("GARAGE_XLA_CACHE_DIR", DEFAULT_CACHE_DIR)
+    if _enabled:
+        return path
+    os.makedirs(path, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache EVERYTHING: the default thresholds skip small/fast compiles,
+    # but on the tunneled backend even "fast" remote compiles can wedge —
+    # a cache hit skips the remote round-trip entirely.
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    try:
+        jax.config.update(
+            "jax_persistent_cache_enable_xla_caches",
+            "all",
+        )
+    except Exception:  # older jax: flag absent — core cache still works
+        pass
+    _enabled = True
+    return path

@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Background TPU banker: probe the tunnel; on a healthy window, run the
+full dial set and save auditable artifacts (round-4, VERDICT Missing #1).
+
+Loop: every --interval seconds run bench.py's 60 s probe child.  When the
+backend answers, immediately run, each in its own killable subprocess:
+
+  1. bench.py            (encode ladder — banks the headline number)
+  2. bench.py --repair   (reconstruction dial)
+  3. bench.py --hash     (fused encode+BLAKE3 at production batch)
+  4. script/tpu_verify.py (on-chip bit-exactness suite)
+
+All stdout/stderr goes to tpu_runs/bank_<ts>.log with UTC timestamps, and
+the winning JSON lines to tpu_runs/banked_<ts>.json.  The persistent XLA
+cache (.xla_cache/) is warmed as a side effect, so later driver runs skip
+compilation.  Exits 0 after one fully-banked window (encode number on
+chip); exits 3 if --max-hours elapses without one.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import json_lines, run_logged  # noqa: E402 — shared runner
+
+
+def log(f, msg):
+    line = f"[{time.strftime('%H:%M:%S', time.gmtime())}Z] {msg}"
+    print(line, flush=True)
+    f.write(line + "\n")
+    f.flush()
+
+
+def run(f, tag, cmd, timeout):
+    log(f, f"{tag}: $ {' '.join(cmd)}")
+    rc, out, err, dt = run_logged(cmd, timeout)
+    for l in (out or "").splitlines():
+        f.write(f"O| {l}\n")
+    for l in (err or "").splitlines():
+        f.write(f"E| {l}\n")
+    log(f, f"{tag}: rc={rc} dt={dt:.1f}s")
+    return rc, out or ""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+    d = os.path.join(REPO, "tpu_runs")
+    os.makedirs(d, exist_ok=True)
+    logpath = os.path.join(d, f"bank_{ts}.log")
+    deadline = time.time() + args.max_hours * 3600
+    py = sys.executable
+
+    with open(logpath, "a") as f:
+        log(f, f"banker start, interval={args.interval}s log={logpath}")
+        while time.time() < deadline:
+            rc, out = run(f, "probe", [py, "bench.py", "--_probe"], 60)
+            lines = json_lines(out)
+            alive = rc == 0 and lines and lines[0].get("platform") not in (None, "cpu")
+            if not alive:
+                time.sleep(args.interval)
+                continue
+
+            log(f, f"HEALTHY WINDOW: {lines[0]}")
+            banked = {"window_utc": time.strftime("%Y-%m-%d %H:%M:%S",
+                                                  time.gmtime()),
+                      "probe": lines[0]}
+            rc, out = run(f, "encode", [py, "bench.py", "--verbose"], 600)
+            enc = [l for l in json_lines(out) if l.get("platform") not in (None, "cpu", "none")]
+            if enc:
+                banked["encode"] = enc[-1]
+            rc, out = run(f, "repair", [py, "bench.py", "--repair", "--verbose"], 600)
+            rep = [l for l in json_lines(out) if l.get("platform") not in (None, "cpu", "none")]
+            if rep:
+                banked["repair"] = rep[-1]
+            rc, out = run(f, "hash", [py, "bench.py", "--hash", "--verbose"], 600)
+            hsh = [l for l in json_lines(out) if l.get("platform") not in (None, "cpu", "none")]
+            if hsh:
+                banked["hash"] = hsh[-1]
+            rc, out = run(f, "verify",
+                          [py, os.path.join("script", "tpu_verify.py")], 600)
+            banked["verify_rc"] = rc
+            banked["verify_tail"] = out.splitlines()[-3:] if out else []
+
+            outpath = os.path.join(d, f"banked_{ts}.json")
+            with open(outpath, "w") as bf:
+                json.dump(banked, bf, indent=1)
+            log(f, f"banked -> {outpath}: {json.dumps(banked)[:400]}")
+            if "encode" in banked:
+                log(f, "full bank complete; exiting 0")
+                return 0
+            log(f, "window closed before encode banked; continuing loop")
+            time.sleep(args.interval)
+        log(f, "max-hours elapsed without a healthy window; exiting 3")
+        return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,10 @@ import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root
 
+from garage_tpu.utils.compile_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
 import jax
 import jax.numpy as jnp
 

@@ -418,3 +418,57 @@ def test_bucket_quota_partial_update_preserves_other(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_admin_api_connect_health_nodeinfo(tmp_path):
+    """Round-4 surface parity (reference router_v1.rs:102-103): standalone
+    GET /v1/health, POST /v1/connect joining a second daemon by
+    "id@host:port" with a per-node result list, and GET /v1/node info."""
+
+    async def main():
+        import aiohttp
+
+        from garage_tpu.api.admin.api_server import AdminApiServer
+        from garage_tpu.utils.data import hex_of
+
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        garage2, s32, _ = await make_daemon(tmp_path, name="node1")
+        garage.config.admin.admin_token = "tok"
+        adm = AdminApiServer(garage)
+        await adm.start("127.0.0.1", 0)
+        port = adm.runner.addresses[0][1]
+        base = f"http://127.0.0.1:{port}"
+        hdr = {"Authorization": "Bearer tok"}
+        try:
+            async with aiohttp.ClientSession(headers=hdr) as sess:
+                async with sess.get(base + "/v1/health") as r:
+                    assert r.status == 200
+                    h = await r.json()
+                    assert h["status"] in ("healthy", "degraded", "unavailable")
+                    assert "partitions_quorum" in h
+
+                async with sess.get(base + "/v1/node") as r:
+                    assert r.status == 200
+                    info = await r.json()
+                    assert info["nodeId"] == hex_of(garage.node_id)
+                    assert info["dbEngine"] == "memory"
+
+                # connect node0 -> node1 plus one garbage address: per-node
+                # results in request order, failure doesn't fail the call
+                addr2 = "{}@127.0.0.1:{}".format(
+                    hex_of(garage2.node_id), garage2.netapp.bind_addr[1]
+                )
+                async with sess.post(
+                    base + "/v1/connect", json=[addr2, "nonsense"]
+                ) as r:
+                    assert r.status == 200
+                    res = await r.json()
+                    assert res[0] == {"success": True, "error": None}
+                    assert res[1]["success"] is False and res[1]["error"]
+                assert garage.netapp.is_connected(garage2.node_id)
+        finally:
+            await adm.stop()
+            await teardown(garage2, s32)
+            await teardown(garage, s3)
+
+    run(main())

@@ -422,3 +422,37 @@ def test_daemon_runs_on_durable_engine(tmp_path, engine):
         await garage2.stop()
 
     asyncio.run(main())
+
+
+def test_iter_range_mid_iteration_contract(db):
+    """Pins the documented (weak) mid-iteration consistency contract of
+    Tree.iter_range (ADVICE r3): engines differ on whether keys inserted
+    ahead of a live cursor are observed (log engine snapshots, native
+    pages through the live map) — but ALL engines must (a) never crash,
+    (b) never skip or duplicate keys that existed when iteration started
+    and weren't touched, and (c) honor the end bound."""
+    t = db.open_tree("iterc")
+    for i in range(0, 100, 2):
+        t.insert(b"k%03d" % i, b"v%d" % i)
+    preexisting = {b"k%03d" % i for i in range(0, 100, 2)}
+
+    seen = []
+    inserted_ahead = False
+    for k, _v in t.iter_range(b"k000", b"k100"):
+        seen.append(k)
+        if not inserted_ahead and k == b"k010":
+            # mutate ahead of and behind the cursor mid-iteration
+            t.insert(b"k095", b"new")  # odd key: ahead, not preexisting
+            t.insert(b"k001", b"new")  # behind: must NOT appear later
+            inserted_ahead = True
+
+    # (b): every untouched preexisting key in range seen exactly once
+    seen_pre = [k for k in seen if k in preexisting]
+    assert seen_pre == sorted(preexisting)
+    # behind-the-cursor insert never shows up (ordered iteration)
+    assert b"k001" not in seen
+    # (c): end bound respected even with mid-iteration inserts
+    assert all(k < b"k100" for k in seen)
+    # ahead-of-cursor insert: MAY be seen (native/sqlite) or not (log) —
+    # both are within contract; just record that it didn't corrupt order
+    assert seen == sorted(seen)
