@@ -42,12 +42,12 @@ N_S3_PUTS = 600
 N_LIST_KEYS = 600
 
 
-def bench_db_engine(engine: str, n: int) -> dict:
+def bench_db_engine(engine: str, n: int, fsync=True) -> dict:
     from garage_tpu.db import open_db
 
     d = tempfile.mkdtemp(prefix=f"benchmeta-{engine}-")
     try:
-        db = open_db(os.path.join(d, "db"), engine=engine)
+        db = open_db(os.path.join(d, "db"), engine=engine, fsync=fsync)
         tree = db.open_tree("bench")
         val = b"v" * 128  # typical small table entry
 
@@ -172,6 +172,17 @@ def main() -> None:
         detail[engine].update(
             asyncio.run(bench_s3_meta(engine, n_puts, n_list))
         )
+    # Relaxed-durability apples-to-apples (bounded-window semantics):
+    # native group commit (C++ flusher, window ~ one fdatasync) vs sqlite
+    # WAL + synchronous=NORMAL (sync at checkpoints).  The reference's
+    # default posture (metadata_fsync = false on LMDB) is this class.
+    if "native" in engines:
+        detail["native"]["group_insert_ops"] = bench_db_engine(
+            "native", n_db, fsync="group"
+        )["insert_ops"]
+    detail["sqlite"]["normal_insert_ops"] = bench_db_engine(
+        "sqlite", n_db, fsync=False
+    )["insert_ops"]
 
     headline = detail["sqlite"]["inline_put_ops"]
     print(

@@ -122,7 +122,7 @@ def build_kv(force: bool = False) -> str | None:
     return _compile(
         [os.path.join(_DIR, s) for s in ("kvpy.cpp", "kvlog.cpp")],
         _KV_SO,
-        extra_flags=[f"-I{inc}"],
+        extra_flags=[f"-I{inc}", "-pthread"],
         tag_extra=":" + str(sysconfig.get_config_var("SOABI")),
         force=force,
     )
@@ -194,6 +194,7 @@ def lib() -> ctypes.CDLL | None:
         ]
         l.kv_iter_chunk.restype = ctypes.c_size_t
         l.kv_compact_now.argtypes = [ctypes.c_void_p]
+        l.kv_sync_barrier.argtypes = [ctypes.c_void_p]
         l.kv_log_bytes.argtypes = [ctypes.c_void_p]
         l.kv_log_bytes.restype = ctypes.c_uint64
         l.kv_live_bytes.argtypes = [ctypes.c_void_p]

@@ -17,8 +17,9 @@
 #include <cstdint>
 
 extern "C" {
-void* kv_open(const char* path, int fsync_on);
+void* kv_open(const char* path, int sync_mode);
 int kv_close(void* h);
+int kv_sync_barrier(void* h);
 int kv_commit(void* h, const uint8_t* payload, size_t len);
 int kv_get(void* h, const char* tree, size_t tlen, const uint8_t* k,
            size_t klen, const uint8_t** out, size_t* outlen);
@@ -41,9 +42,9 @@ void* handle_of(PyObject* obj) {
 
 PyObject* py_open(PyObject*, PyObject* args) {
   const char* path;
-  int fsync_on;
-  if (!PyArg_ParseTuple(args, "sp", &path, &fsync_on)) return nullptr;
-  void* h = kv_open(path, fsync_on);
+  int sync_mode;  // 0 none, 1 full, 2 group
+  if (!PyArg_ParseTuple(args, "si", &path, &sync_mode)) return nullptr;
+  void* h = kv_open(path, sync_mode);
   if (h == nullptr) {
     PyErr_Format(PyExc_OSError, "cannot open native kv log at '%s'", path);
     return nullptr;
@@ -198,6 +199,22 @@ PyObject* py_compact(PyObject*, PyObject* args) {
   Py_RETURN_NONE;
 }
 
+PyObject* py_sync_barrier(PyObject*, PyObject* args) {
+  PyObject* hobj;
+  if (!PyArg_ParseTuple(args, "O", &hobj)) return nullptr;
+  void* h = handle_of(hobj);
+  if (h == nullptr && PyErr_Occurred()) return nullptr;
+  int rc;
+  Py_BEGIN_ALLOW_THREADS  // may block on the flusher's fdatasync
+  rc = kv_sync_barrier(h);
+  Py_END_ALLOW_THREADS
+  if (rc != 0) {
+    PyErr_SetString(PyExc_OSError, "native kv sync barrier failed");
+    return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
 PyObject* py_log_bytes(PyObject*, PyObject* args) {
   PyObject* hobj;
   if (!PyArg_ParseTuple(args, "O", &hobj)) return nullptr;
@@ -225,6 +242,8 @@ PyMethodDef methods[] = {
      "iter_chunk(handle, tree, start, end, reverse, max_items, cap) -> "
      "(bytes, done)"},
     {"compact", py_compact, METH_VARARGS, "compact(handle)"},
+    {"sync_barrier", py_sync_barrier, METH_VARARGS,
+     "sync_barrier(handle) — wait until all acked commits are durable"},
     {"log_bytes", py_log_bytes, METH_VARARGS, "log_bytes(handle) -> int"},
     {"live_bytes", py_live_bytes, METH_VARARGS, "live_bytes(handle) -> int"},
     {nullptr, nullptr, 0, nullptr},

@@ -45,14 +45,18 @@ class _CtypesBinding:
         self._ct = ctypes
         self._l = l
 
-    def open(self, path: str, fsync: bool) -> int:
-        h = self._l.kv_open(path.encode(), 1 if fsync else 0)
+    def open(self, path: str, sync_mode: int) -> int:
+        h = self._l.kv_open(path.encode(), int(sync_mode))
         if not h:
             raise OSError(f"cannot open native kv log at {path!r}")
         return h
 
     def close(self, h) -> None:
         self._l.kv_close(h)
+
+    def sync_barrier(self, h) -> None:
+        if self._l.kv_sync_barrier(h) != 0:
+            raise OSError("native kv sync barrier failed")
 
     def commit(self, h, payload: bytes) -> None:
         rc = self._l.kv_commit(h, payload, len(payload))
@@ -207,14 +211,23 @@ class NativeTx(Tx):
 class NativeDb(Db):
     engine = "native"
 
-    def __init__(self, path: str, fsync: bool = True, binding=None):
-        """`binding` overrides the kv backend (an object shaped like the
+    def __init__(self, path: str, fsync: bool | str = True, binding=None):
+        """`fsync` selects the durability mode: True = fdatasync inside
+        every commit; "group" = group commit (commits ack immediately, a
+        C++ flusher thread runs fdatasync continuously — durability
+        window ~ one fdatasync, same class as sqlite WAL+NORMAL and the
+        reference's default metadata_fsync=false LMDB posture;
+        `sync_barrier()` forces full durability); False = sync only at
+        compaction/close.
+
+        `binding` overrides the kv backend (an object shaped like the
         garage_kv module) — used by the sanitizer job to force the ctypes
         path against an instrumented .so."""
         self.kv = binding if binding is not None else _binding()
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.h = self.kv.open(path, fsync)
+        mode = 2 if fsync == "group" else (1 if fsync else 0)
+        self.h = self.kv.open(path, mode)
         self.trees: dict[str, NativeTree] = {}
         self._in_tx = False
         for name in self._native_tree_names():
@@ -299,6 +312,11 @@ class NativeDb(Db):
         if tx.order:
             self.kv.commit(self.h, b"".join(tx.order))
         return res
+
+    def sync_barrier(self) -> None:
+        """Block until every acknowledged commit is on stable storage
+        (group mode waits out the flusher; other modes fdatasync)."""
+        self.kv.sync_barrier(self.h)
 
     def snapshot(self, to_dir: str) -> None:
         os.makedirs(to_dir, exist_ok=True)

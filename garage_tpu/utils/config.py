@@ -107,7 +107,10 @@ class Config:
     # the durability story there.  Engine mapping: log/native skip the
     # per-commit fdatasync; sqlite runs WAL+synchronous=NORMAL (sync at
     # checkpoints only) vs FULL when true.
-    metadata_fsync: bool = False
+    # Round 4: the native engine also accepts "group" — group commit, a
+    # C++ flusher coalesces concurrent commits into shared fdatasyncs
+    # (durability window ~ one fdatasync; full sync at barriers).
+    metadata_fsync: bool | str = False
     data_fsync: bool = False
     metadata_auto_snapshot_interval: int | None = None  # msec
     metadata_snapshots_dir: str | None = None  # default <metadata_dir>/snapshots

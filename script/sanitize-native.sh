@@ -86,5 +86,22 @@ db2 = NativeDb(path, fsync=False, binding=binding)
 assert dict(db2.open_tree("t").iter_range()) == model
 db2.close()
 
+# group-commit mode: the flusher THREAD races commits/compactions under
+# the sanitizer — commit storms, explicit barriers, forced compactions
+path3 = os.path.join(tmp, "san-group.log")
+db3 = NativeDb(path3, fsync="group", binding=binding)
+t3 = db3.open_tree("g")
+for i in range(6000):
+    t3.insert(b"gk%05d" % (i % 512), os.urandom(64))
+    if i % 1000 == 999:
+        db3.sync_barrier()
+        db3.kv.compact(db3.h)
+db3.sync_barrier()
+assert len(t3) == 512
+db3.close()
+db4 = NativeDb(path3, fsync="group", binding=binding)
+assert len(db4.open_tree("g")) == 512
+db4.close()
+
 print("sanitized native library: all oracle checks passed (ASan+UBSan clean)")
 EOF

@@ -1,0 +1,17 @@
+"""SIGKILL victim for test_db group-commit durability: inserts keys in
+group mode forever, printing each acked key to stdout."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from garage_tpu.db import open_db
+
+db = open_db(sys.argv[1], engine="native", fsync="group")
+t = db.open_tree("gc")
+i = 0
+while True:
+    k = b"k%08d" % i
+    t.insert(k, b"v" * 64)
+    print(i, flush=True)  # acked
+    i += 1
