@@ -77,28 +77,103 @@ def parse_args(argv):
                     help="single child on the default backend (old behavior)")
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--_probe_phase", default="dispatch", help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
     if args.hash and args.repair:
         ap.error("--hash and --repair are mutually exclusive")
     return args
 
 
-def probe_main() -> None:
-    """Tiny backend liveness check — the 60 s canary for the ladder."""
+def probe_main(phase: str = "dispatch") -> None:
+    """Phase-stamped backend liveness check (VERDICT r4 ask #1).
+
+    Prints a flushed timestamped JSON line after each phase so that even
+    when the parent hard-kills a wedged child, the partial pipe output
+    pins WHICH phase wedged:
+
+      import   — interpreter start + `import jax` (plugin registration;
+                 the axon sitecustomize dials the tunnel at interp start)
+      devices  — `jax.devices()` (PJRT client init + device enumeration)
+      dispatch — 16-byte jit dispatch + host fetch (executor round-trip)
+
+    `phase` stops early, letting the parent bracket a wedge with shorter
+    single-phase children when the full probe times out.
+    """
+    t0 = time.time()
+
+    def stamp(name):
+        print(json.dumps({"phase": name, "t": round(time.time() - t0, 3)}),
+              flush=True)
+
     from garage_tpu.utils.compile_cache import enable_persistent_cache
 
+    import jax  # noqa: F401 — plugin registration side effect
+
+    stamp("import")
+    if phase == "import":
+        return
     enable_persistent_cache()
+    devs = jax.devices()
+    stamp("devices")
+    if phase == "devices":
+        print(json.dumps({"probe": "devices-ok",
+                          "platform": devs[0].platform}), flush=True)
+        return
     import numpy as np
 
-    import jax
     import jax.numpy as jnp
 
-    dev = jax.devices()[0]
-    x = jnp.ones((256, 256), jnp.bfloat16)
-    y = jax.jit(lambda a: a @ a)(x)
-    np.asarray(y[:1, :1])  # honest host-fetch barrier
-    print(json.dumps({"probe": "ok", "platform": dev.platform,
-                      "device": str(dev)}))
+    x = jnp.arange(16, dtype=jnp.uint8)  # 16-byte dispatch
+    y = jax.jit(lambda a: a + 1)(x)
+    np.asarray(y[:1])  # honest host-fetch barrier
+    stamp("dispatch")
+    print(json.dumps({"probe": "ok", "platform": devs[0].platform,
+                      "device": str(devs[0])}))
+
+
+def phased_probe(env, transcript=None):
+    """Run the liveness probe with per-phase wedge attribution.
+
+    Full probe first (60 s).  On success returns its final JSON line.  On
+    wedge/failure, runs shorter single-phase children to bracket where the
+    backend stalls, then writes `tpu_runs/probe_profile_<ts>.json` — the
+    committed per-phase wedge profile VERDICT r4 asked for — and returns
+    None.
+    """
+    me = os.path.abspath(__file__)
+
+    def run_phase(phase, timeout):
+        cmd = [sys.executable, me, "--_probe", "--_probe_phase", phase]
+        rc, out, err, dt = run_logged(cmd, timeout, env=env)
+        if transcript:
+            transcript.record(f"probe-{phase}", cmd, rc, out, err, dt)
+        stamps = [l for l in json_lines(out) if "phase" in l]
+        final = [l for l in json_lines(out) if "probe" in l]
+        return {"phase_arg": phase, "rc": rc, "dt": round(dt, 1),
+                "stamps": stamps, "final": final[-1] if final else None}
+
+    full = run_phase("dispatch", PROBE_TIMEOUT)
+    if full["rc"] == 0 and full["final"] and full["final"].get("probe") == "ok":
+        return full["final"]
+
+    # Wedged or failed: the stamps in the partial output already say which
+    # phases completed; bracket with single-phase children for confirmation.
+    profile = {"utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
+               "full": full,
+               "brackets": [run_phase("import", 45), run_phase("devices", 45)]}
+    reached = [s["phase"] for s in full["stamps"]]
+    order = ["import", "devices", "dispatch"]
+    wedged_at = next((p for p in order if p not in reached), "after-dispatch")
+    profile["wedged_at"] = wedged_at
+    d = os.path.join(REPO, "tpu_runs")
+    os.makedirs(d, exist_ok=True)
+    ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+    path = os.path.join(d, f"probe_profile_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(profile, f, indent=1)
+    print(f"# probe wedged at phase '{wedged_at}'; profile -> {path}",
+          file=sys.stderr)
+    return None
 
 
 def child_main(args) -> None:
@@ -310,7 +385,7 @@ def main() -> None:
     argv = sys.argv[1:]
     args = parse_args(argv)
     if args._probe:
-        probe_main()
+        probe_main(args._probe_phase)
         return
     if args._child:
         child_main(args)
@@ -321,8 +396,9 @@ def main() -> None:
     result = None
     argv = [a for a in argv if a != "--no-ladder"]
 
-    # Step 1: 60 s canary.  A wedged tunnel dies here, not at 360 s.
-    probe = run_child(["--_probe"], env, PROBE_TIMEOUT, tr, "probe")
+    # Step 1: phase-stamped canary.  A wedged tunnel dies here (with a
+    # committed per-phase wedge profile), not at 360 s.
+    probe = phased_probe(env, tr)
     tpu_ok = bool(probe) and probe.get("platform") not in (None, "cpu")
 
     if tpu_ok and not args.no_ladder:

@@ -11,8 +11,10 @@ directory inside the repo, so:
 
 Cache entries are keyed by jax version + backend fingerprint + HLO, so they
 are valid across processes on the same box/chip — exactly the driver's
-situation.  Entries are committed to git like the `_native/*.so` compile
-caches: stale entries are simply misses, never wrong results.
+situation.  The background banker (`script/tpu_bank.py`) git-commits
+`.xla_cache/` together with each banked window's artifacts; until a healthy
+window populates it, the directory is empty and every entry is a miss
+(stale entries are also just misses, never wrong results).
 
 Reference analog: none (the reference is interpreted Rust; its hot loops
 don't have a compile step).  This is TPU-operational plumbing.
