@@ -23,8 +23,12 @@ unwedge it; and rounds 1-3 showed a single 360 s do-everything child banks
 NOTHING when any stage of it wedges).  The parent never imports jax and
 runs a LADDER of short, independently-killable children:
 
-  1. probe (60 s): init the backend, one tiny matmul + host fetch.
-     Wedged tunnel -> dies here, 60 s spent, straight to CPU fallback.
+  1. probe (60 s full + 2x45 s single-phase brackets): init the backend,
+     one tiny matmul + host fetch.  A wedged tunnel dies here after
+     ~150 s total (the brackets pin WHICH phase wedged), then straight
+     to CPU fallback; a probe that exits quickly with an ordinary error
+     (rc!=0, e.g. an ImportError) skips the brackets entirely and is
+     labeled `failed`, not `wedged`.
   2. quick dial (150 s): small-batch measurement on the einsum path
      (plain XLA, no Mosaic remote-compile exposure) -> banks a first
      "platform": "tpu" line.
@@ -156,23 +160,39 @@ def phased_probe(env, transcript=None):
     if full["rc"] == 0 and full["final"] and full["final"].get("probe") == "ok":
         return full["final"]
 
-    # Wedged or failed: the stamps in the partial output already say which
-    # phases completed; bracket with single-phase children for confirmation.
     profile = {"utc": time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime()),
-               "full": full,
-               "brackets": [run_phase("import", 45), run_phase("devices", 45)]}
-    reached = [s["phase"] for s in full["stamps"]]
-    order = ["import", "devices", "dispatch"]
-    wedged_at = next((p for p in order if p not in reached), "after-dispatch")
-    profile["wedged_at"] = wedged_at
+               "full": full}
+    # A child that exits QUICKLY with an ordinary error (rc != 0 — an
+    # ImportError, a plugin crash) is not a wedge: the ~90 s of bracket
+    # children would only re-confirm the same error and the resulting
+    # "wedged_at" profile would be a lie.  Brackets are for wedges only
+    # (TIMEOUT, or a kill that ate most of the budget).
+    fast_error = (
+        full["rc"] not in (0, "TIMEOUT") and full["dt"] < PROBE_TIMEOUT / 2
+    )
+    if fast_error:
+        profile["result"] = "failed"
+        profile["wedged_at"] = None
+    else:
+        profile["result"] = "wedged"
+        profile["brackets"] = [run_phase("import", 45), run_phase("devices", 45)]
+        reached = [s["phase"] for s in full["stamps"]]
+        order = ["import", "devices", "dispatch"]
+        profile["wedged_at"] = next(
+            (p for p in order if p not in reached), "after-dispatch"
+        )
     d = os.path.join(REPO, "tpu_runs")
     os.makedirs(d, exist_ok=True)
     ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
     path = os.path.join(d, f"probe_profile_{ts}.json")
     with open(path, "w") as f:
         json.dump(profile, f, indent=1)
-    print(f"# probe wedged at phase '{wedged_at}'; profile -> {path}",
-          file=sys.stderr)
+    if fast_error:
+        print(f"# probe failed fast (rc={full['rc']}, {full['dt']}s); "
+              f"profile -> {path}", file=sys.stderr)
+    else:
+        print(f"# probe wedged at phase '{profile['wedged_at']}'; "
+              f"profile -> {path}", file=sys.stderr)
     return None
 
 

@@ -104,8 +104,12 @@ async def run_bigget(tmp_path, size: int, depths: list[int]) -> dict:
         # simulate same-region inter-node RTT (reference benches with
         # mknet 100ms geo RTT; 2ms keeps the run short while making
         # per-block round-trips the bottleneck they are in production)
+        from garage_tpu.net.fault import FaultPlan, FaultRule
+
         for g in garages:
-            g.netapp.injected_latency_ms = 2.0
+            g.netapp.fault_plan = FaultPlan(0).set_rule(
+                FaultRule(latency_ms=2.0)
+            )
         out = {}
         for d in depths:
             objects_mod.GET_PREFETCH_DEPTH = d

@@ -25,3 +25,7 @@ Layer map (mirrors reference workspace crates, SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# Optional-dependency fallbacks (zlib-backed `zstandard` shim, etc.) must
+# be installed before any submodule import pulls the real names.
+from .utils import depcompat as _depcompat  # noqa: E402,F401

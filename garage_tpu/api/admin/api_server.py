@@ -180,6 +180,8 @@ class AdminApiServer:
         if path == "/v1/status" and request.method == "GET":
             h = g.system.health()
             cur = g.layout_manager.history.current()
+            ph = getattr(g, "peer_health", None)
+            rpc_health = ph.snapshot() if ph is not None else {}
             nodes = []
             for nid in set(
                 list(cur.roles.keys()) + [g.node_id] + list(g.system.peering.peers.keys())
@@ -196,6 +198,10 @@ class AdminApiServer:
                         if role
                         else None,
                         "isUp": nid == g.node_id or g.netapp.is_connected(nid),
+                        # circuit-breaker / EWMA view of this peer from the
+                        # answering node (rpc/peer_health.py); None for
+                        # self and never-contacted peers
+                        "rpcHealth": rpc_health.get(hex_of(nid)),
                     }
                 )
             return web.json_response(

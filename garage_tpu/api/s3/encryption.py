@@ -17,7 +17,10 @@ import base64
 import hashlib
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # SSE-C needs real AES-GCM; everything else works without it
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:
+    AESGCM = None
 
 from ..common.error import ApiError, BadRequest
 
@@ -38,6 +41,11 @@ class EncryptionParams:
     """Parsed + validated SSE-C request parameters."""
 
     def __init__(self, key: bytes, key_md5_b64: str):
+        if AESGCM is None:
+            raise BadRequest(
+                "SSE-C unavailable: the 'cryptography' package is not "
+                "installed on this server"
+            )
         self.key = key
         self.key_md5_b64 = key_md5_b64
         self._aead = AESGCM(key)

@@ -203,6 +203,9 @@ class BlockManager:
         self.disable_scrub = disable_scrub
         self.buffers = ByteBudget(ram_buffer_max)
         self.rc = BlockRc(db)
+        # seedable disk-fault seam (net/fault.py FaultPlan): when set,
+        # local block reads/writes may fail per the plan's probabilities
+        self.fault_plan = None
 
         self._layout_persister: Persister[DataLayout] = Persister(
             metadata_dir, "data_layout", DataLayout
@@ -291,6 +294,12 @@ class BlockManager:
         self, hash32: bytes, stored: bytes, compressed: bool, piece: int = 0
     ) -> None:
         """Store already-encoded bytes (compressed or plain) for hash."""
+        if self.fault_plan is not None and self.fault_plan.should_fail_disk(
+            "write"
+        ):
+            from ..net.fault import InjectedDiskFault
+
+            raise InjectedDiskFault("injected block write fault")
         async with self._locks[hash32[0]]:
             existing = self.find_block_file(hash32, piece=piece)
             if existing is not None:
@@ -318,6 +327,16 @@ class BlockManager:
         """Read + verify + decompress the locally stored piece/block."""
         found = self.find_block_file(hash32)
         if found is None:
+            return None
+        if self.fault_plan is not None and self.fault_plan.should_fail_disk(
+            "read"
+        ):
+            # an unreadable sector behaves like a local miss: the caller
+            # falls back to peers, resync re-examines the block
+            logger.warning(
+                "injected block read fault for %s", hash32.hex()[:16]
+            )
+            self.resync.queue_block(hash32)
             return None
         path, compressed = found
         with open(path, "rb") as f:
@@ -481,8 +500,8 @@ class BlockManager:
 
         async def one(n: bytes, i: int) -> None:
             try:
-                await self.endpoint.call(
-                    n,
+                await self.helper.call(
+                    self.endpoint, n,
                     ["Put", hash32,
                      {"c": False, "p": i, "l": len(data),
                       "s": len(pieces[i])}],
@@ -491,7 +510,7 @@ class BlockManager:
                     # longer per-send default would abort slow-but-alive
                     # sends as "quorum failure" with an empty error list
                     timeout=self.helper.default_timeout,
-                    stream=bytes_stream(pieces[i]),
+                    stream_factory=lambda i=i: bytes_stream(pieces[i]),
                 )
                 ok.add((n, i))
             except Exception as e:  # noqa: BLE001 — tallied for Quorum
@@ -595,8 +614,12 @@ class BlockManager:
                 if n == self.system.id:
                     continue
                 try:
-                    resp = await self.endpoint.call(
-                        n, ["Get", hash32], prio=prio, order_tag=order_tag
+                    # health-tracked + retried: a sick peer fast-fails
+                    # (circuit breaker) instead of stalling the GET, and
+                    # transient transport blips retry with jittered backoff
+                    resp = await self.helper.call(
+                        self.endpoint, n, ["Get", hash32], prio=prio,
+                        order_tag=order_tag, idempotent=True,
                     )
                     declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
                     # reserve before buffering; held through decompress+verify
@@ -628,8 +651,9 @@ class BlockManager:
             if found[1]:
                 stored = zstandard.decompress(stored)
             return unwrap_piece(stored)
-        resp = await self.endpoint.call(
-            node, ["Get", hash32, piece], prio=prio, order_tag=order_tag
+        resp = await self.helper.call(
+            self.endpoint, node, ["Get", hash32, piece], prio=prio,
+            order_tag=order_tag, idempotent=True,
         )
         meta, stored = await _resp_payload(resp, budget=self.buffers)
         if meta.get("c"):
@@ -677,7 +701,10 @@ class BlockManager:
                 if exclude_self and n == self.system.id:
                     continue
                 try:
-                    resp = await self.endpoint.call(n, ["Pieces", hash32], prio=prio)
+                    resp = await self.helper.call(
+                        self.endpoint, n, ["Pieces", hash32], prio=prio,
+                        idempotent=True,
+                    )
                     for pi in resp.body or []:
                         pi = int(pi)
                         if pi not in pieces:

@@ -21,6 +21,7 @@ import logging
 import os
 
 from ..net.message import PRIO_BACKGROUND
+from ..utils.backoff import expo
 from ..utils.background import BackgroundRunner, Worker, WorkerState
 from ..utils.time_util import now_msec
 from ..utils.tranquilizer import Tranquilizer
@@ -99,7 +100,7 @@ class BlockResyncManager:
                 count = 0
                 if err is not None:
                     count = msgpack.unpackb(err)[0]
-                backoff = min(BACKOFF_MAX_MS, BACKOFF_MIN_MS * (2 ** min(count, 6)))
+                backoff = int(expo(count, BACKOFF_MIN_MS, BACKOFF_MAX_MS))
                 self.errors.insert(
                     hash32, msgpack.packb([count + 1, now_msec() + backoff])
                 )
@@ -154,8 +155,9 @@ class BlockResyncManager:
                 distinct: set[int] = set()
                 for n in nodes[: mgr.codec.n_pieces]:
                     try:
-                        resp = await mgr.endpoint.call(
-                            n, ["Pieces", hash32], prio=PRIO_BACKGROUND
+                        resp = await mgr.helper.call(
+                            mgr.endpoint, n, ["Pieces", hash32],
+                            prio=PRIO_BACKGROUND, idempotent=True,
                         )
                         distinct.update(int(p) for p in resp.body or [])
                     except Exception as e:
@@ -191,8 +193,9 @@ class BlockResyncManager:
                 if n == mgr.system.id:
                     continue
                 try:
-                    resp = await mgr.endpoint.call(
-                        n, ["Need", hash32], prio=PRIO_BACKGROUND
+                    resp = await mgr.helper.call(
+                        mgr.endpoint, n, ["Need", hash32],
+                        prio=PRIO_BACKGROUND, idempotent=True,
                     )
                     if resp.body:
                         found = mgr.find_block_file(hash32)
@@ -203,13 +206,15 @@ class BlockResyncManager:
                             with open(path, "rb") as f:
                                 stored = f.read()
                             async with mgr.buffers.reserve(len(stored)):
-                                await mgr.endpoint.call(
-                                    n,
+                                # content-addressed Put: safe to retry
+                                await mgr.helper.call(
+                                    mgr.endpoint, n,
                                     ["Put", hash32,
                                      {"c": compressed, "s": len(stored)}],
                                     prio=PRIO_BACKGROUND,
                                     timeout=120.0,
-                                    stream=bytes_stream(stored),
+                                    stream_factory=lambda: bytes_stream(stored),
+                                    idempotent=True,
                                 )
                 except Exception as e:
                     raise RuntimeError(

@@ -152,10 +152,18 @@ class Garage:
             public_addr=public_addr,
             discovery=discovery_from_config(config),
         )
+        # one PeerHealth instance shared by the RPC helper (call outcomes,
+        # breaker gating) and the peering layer (ping outcomes): pings are
+        # the background probe that detects a sick peer healing
+        from ..rpc.peer_health import PeerHealth
+
+        self.peer_health = PeerHealth(self.node_id)
         self.helper_rpc = RpcHelper(
             self.node_id, self.system.peering,
             default_timeout=config.rpc_timeout_msec / 1000.0,
+            health=self.peer_health,
         )
+        self.system.peering.health = self.peer_health
 
         def _zone_of(nid: bytes) -> str | None:
             for v in reversed(self.layout_manager.history.versions):

@@ -20,7 +20,16 @@ Capability-parity with the reference's netapp fork (src/net/, SURVEY.md
     (reference src/net/peering.rs:23-50)
 """
 
+from .fault import FaultPlan, FaultRule
 from .message import PRIO_BACKGROUND, PRIO_HIGH, PRIO_NORMAL
 from .netapp import NetApp, RpcError
 
-__all__ = ["NetApp", "RpcError", "PRIO_HIGH", "PRIO_NORMAL", "PRIO_BACKGROUND"]
+__all__ = [
+    "NetApp",
+    "RpcError",
+    "FaultPlan",
+    "FaultRule",
+    "PRIO_HIGH",
+    "PRIO_NORMAL",
+    "PRIO_BACKGROUND",
+]

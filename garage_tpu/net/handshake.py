@@ -33,17 +33,20 @@ import os
 import struct
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+from .crypto_compat import (
+    HAVE_REAL_CRYPTO,
+    ChaCha20Poly1305,
     Ed25519PrivateKey,
     Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
     X25519PrivateKey,
     X25519PublicKey,
 )
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 
-VERSION_TAG = b"grg_tpu2"  # protocol version gate (2: stream flow control)
+# protocol version gate (2: stream flow control).  The insecure stdlib
+# fallback transport (crypto_compat.py) announces a DIFFERENT tag, so a
+# fallback node and a real-crypto node refuse each other at the first
+# hello instead of silently downgrading the cluster's transport security.
+VERSION_TAG = b"grg_tpu2" if HAVE_REAL_CRYPTO else b"grg_tpuF"
 MAX_FRAME = 20 * 1024
 
 

@@ -97,9 +97,11 @@ class NetApp:
         # fault-injection seam (chaos tests): peers in this set are
         # unreachable — calls fail fast, like a network partition
         self.blocked_peers: set[bytes] = set()
-        # one-way latency (ms) added to every outgoing remote call
-        # (benchmark/chaos seam simulating inter-node RTT)
-        self.injected_latency_ms: float = 0.0
+        # seedable deterministic fault plane (net/fault.py FaultPlan):
+        # per-peer latency/jitter (also the bench seam for simulated
+        # inter-node RTT), probabilistic drop (hang-to-timeout), and
+        # response-stream truncation for outgoing + served traffic
+        self.fault_plan = None
         self.on_connected: Callable[[bytes, bool], None] | None = None
         self.on_disconnected: Callable[[bytes], None] | None = None
 
@@ -119,7 +121,21 @@ class NetApp:
 
         with span("rpc-handle:" + path, from_=from_id.hex()[:16]):
             with registry.timer("rpc_handle_duration", (("endpoint", path),)):
-                return await ep.handler(from_id, req)
+                resp = await ep.handler(from_id, req)
+        if (
+            self.fault_plan is not None
+            and from_id != self.id
+            and resp.stream is not None
+        ):
+            # nemesis: this node's uplink may cut served streams short
+            resp = Resp(
+                resp.body,
+                stream=self.fault_plan.maybe_truncate_stream(
+                    from_id, resp.stream
+                ),
+                order_tag=resp.order_tag,
+            )
+        return resp
 
     # --- connections ---------------------------------------------------------
 
@@ -213,11 +229,18 @@ class NetApp:
             return await self._dispatch(path, self.id, req)
         if target in self.blocked_peers:
             raise RpcError(f"peer {target.hex()[:16]} unreachable (partition)")
-        if self.injected_latency_ms:
-            # fault/latency-injection seam (benchmarks + chaos tests):
-            # simulate inter-node RTT like the reference's mknet-based
-            # benchmarks (doc/book/design/benchmarks: 100ms RTT runs)
-            await asyncio.sleep(self.injected_latency_ms / 1000.0)
+        if self.fault_plan is not None:
+            delay = self.fault_plan.rpc_delay(target)
+            if delay:
+                await asyncio.sleep(delay)
+            if self.fault_plan.should_drop(target):
+                # a lost request: hang until the caller's timeout fires,
+                # like a real dropped packet (this is what exercises the
+                # adaptive timeouts + circuit breaker, not a fast error)
+                await asyncio.sleep(timeout if timeout is not None else 3600.0)
+                raise asyncio.TimeoutError(
+                    f"injected drop to {target.hex()[:16]}"
+                )
         conn = self.conns.get(target)
         if conn is None:
             raise RpcError(f"not connected to {target.hex()[:16]}")

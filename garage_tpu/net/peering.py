@@ -14,12 +14,19 @@ import random
 import time
 from dataclasses import dataclass, field
 
+from ..utils.backoff import expo, jittered
 from .message import PRIO_HIGH, Req, Resp
 from .netapp import NetApp
 
 logger = logging.getLogger("garage.peering")
 
 PING_INTERVAL = 15.0
+# a peer whose circuit breaker is not closed gets pinged at this much
+# faster cadence: RPC traffic to it is being fast-failed, so these pings
+# are the only probe that notices the peer healing — at 15 s, a healed
+# peer could be fast-failed for up to 15 extra seconds while every
+# sync/queue worker sinks deeper into its error backoff
+SICK_PING_INTERVAL = 2.0
 FAILED_PING_THRESHOLD = 4
 PING_TIMEOUT = 10.0
 CONNECT_RETRY_BASE = 1.0
@@ -37,6 +44,11 @@ class PeerInfo:
     connect_failures: int = 0
     next_retry: float = 0.0
     rtts: list[float] = field(default_factory=list)
+    # at most ONE ping in flight per peer: the sick-peer cadence (2 s) is
+    # shorter than PING_TIMEOUT (10 s), so without this guard a dark peer
+    # would accumulate ~5 concurrent hanging pings whose STALE failures
+    # land after the peer heals and re-open its circuit breaker
+    ping_inflight: bool = False
 
 
 class PeeringManager:
@@ -59,6 +71,11 @@ class PeeringManager:
         for pid, addr in bootstrap:
             if pid != netapp.id:
                 self.peers[pid] = PeerInfo(id=pid, addr=addr)
+        # optional rpc/peer_health.PeerHealth: ping outcomes feed the
+        # same breaker/EWMA state the RpcHelper uses (wired by the
+        # composition root); pings bypass the breaker on purpose — they
+        # are the background probe that detects healing
+        self.health = None
         self.ping_ep = netapp.endpoint("net/ping")
         self.ping_ep.set_handler(self._handle_ping)
         self.peerlist_ep = netapp.endpoint("net/peer_list")
@@ -132,14 +149,23 @@ class PeeringManager:
         now = time.monotonic()
         for p in list(self.peers.values()):
             if self.netapp.is_connected(p.id):
-                if now - p.last_seen >= PING_INTERVAL:
+                interval = PING_INTERVAL
+                if (
+                    self.health is not None
+                    and self.health.state_of(p.id) != "closed"
+                ):
+                    interval = SICK_PING_INTERVAL
+                if now - p.last_seen >= interval:
                     asyncio.create_task(self._ping(p))
             elif p.addr and now >= p.next_retry:
                 p.state = "connecting"
                 asyncio.create_task(self._try_connect(p))
 
     async def _ping(self, p: PeerInfo) -> None:
-        p.last_seen = time.monotonic()  # don't double-ping while in flight
+        if p.ping_inflight:
+            return
+        p.ping_inflight = True
+        p.last_seen = time.monotonic()  # reset the cadence clock
         nonce = random.getrandbits(63)
         t0 = time.monotonic()
         try:
@@ -152,6 +178,8 @@ class PeeringManager:
             p.rtts = (p.rtts + [p.ping_rtt])[-16:]
             p.failed_pings = 0
             p.state = "up"
+            if self.health is not None:
+                self.health.record_success(p.id, p.ping_rtt)
             # piggyback peer-list exchange on successful pings
             resp = await self.peerlist_ep.call(
                 p.id, self._known_list(), prio=PRIO_HIGH,
@@ -160,11 +188,15 @@ class PeeringManager:
             self._learn(resp.body or [])
         except Exception:  # noqa: BLE001
             p.failed_pings += 1
+            if self.health is not None:
+                self.health.record_failure(p.id)
             if p.failed_pings >= FAILED_PING_THRESHOLD:
                 p.state = "down"
                 conn = self.netapp.conns.get(p.id)
                 if conn:
                     await conn.close()
+        finally:
+            p.ping_inflight = False
 
     async def _try_connect(self, p: PeerInfo) -> None:
         try:
@@ -172,10 +204,9 @@ class PeeringManager:
         except Exception as e:  # noqa: BLE001
             p.connect_failures += 1
             p.state = "down"
-            delay = min(
-                CONNECT_RETRY_MAX,
-                CONNECT_RETRY_BASE * (2 ** min(p.connect_failures, 6)),
-            ) * (0.75 + random.random() / 2)
+            delay = jittered(
+                expo(p.connect_failures, CONNECT_RETRY_BASE, CONNECT_RETRY_MAX)
+            )
             p.next_retry = time.monotonic() + delay
             logger.debug("connect to %s failed: %r", p.id.hex()[:8], e)
 

@@ -8,23 +8,35 @@
       quorum in EVERY active layout version's node set; leftover requests
       keep running in the background so slow nodes still converge
       (reference rpc_helper.rs:432-533)
+
+Every remote call is health-tracked (rpc/peer_health.py): a per-peer
+circuit breaker fast-fails calls to known-dead peers, timeouts adapt to
+the peer's observed RTT, idempotent calls retry with jittered backoff,
+and request_order deprioritizes sick peers.  See
+doc/fault-injection.md.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any
 
+from ..net.connection import ConnectionClosed, RemoteError
 from ..net.message import PRIO_NORMAL
 from ..net.netapp import Endpoint
+from ..utils.backoff import Backoff
 from ..utils.background import spawn
 from ..utils.error import Quorum
 from ..utils.metrics import registry
+from .peer_health import PeerHealth, PeerUnavailable
 
 logger = logging.getLogger("garage.rpc")
 
 STAGGER_DELAY = 0.2  # launch an extra request if no reply within this
+RETRY_BASE = 0.05  # idempotent-call retry backoff (jittered-exponential)
+RETRY_MAX = 2.0
 
 
 def _quorum_fail(lbl: tuple, quorum: int, got: int, errors: list[str]):
@@ -33,11 +45,30 @@ def _quorum_fail(lbl: tuple, quorum: int, got: int, errors: list[str]):
     raise Quorum(quorum, got, errors)
 
 
+def _is_transport_error(e: BaseException) -> bool:
+    """Failures that say something about the PEER/LINK (feed the breaker,
+    eligible for idempotent retry) vs application-level errors."""
+    from ..net.netapp import RpcError
+
+    return isinstance(
+        e, (asyncio.TimeoutError, ConnectionClosed, OSError, RpcError)
+    ) and not isinstance(e, RemoteError)
+
+
 class RpcHelper:
-    def __init__(self, our_id: bytes, peering, default_timeout: float = 30.0):
+    def __init__(
+        self,
+        our_id: bytes,
+        peering,
+        default_timeout: float = 30.0,
+        health: PeerHealth | None = None,
+    ):
         self.our_id = our_id
         self.peering = peering
         self.default_timeout = default_timeout
+        # per-peer health/breaker state; the composition root shares one
+        # instance with the peering layer so ping outcomes feed it too
+        self.health = health or PeerHealth(our_id)
         # node_id -> zone name (or None), wired by the composition root
         # from the current cluster layout; used by request_order
         self.zone_of = None
@@ -48,19 +79,28 @@ class RpcHelper:
         """Self first, then same-zone nodes, then by ascending observed
         ping rtt (reference rpc_helper.rs:621-648: "priorize ourself, then
         nodes in the same zone, and within a same zone ... lowest
-        latency").  Zone lookup comes from `self.zone_of` (wired to the
-        cluster layout by the composition root); without it the order
-        degrades to self-then-rtt."""
+        latency").  Known-sick peers (open breaker / collapsed success
+        rate) sort after every healthy one regardless of zone or rtt, so
+        staggered reads don't spend their first quorum slots on nodes
+        that will fast-fail or stall.  Zone lookup comes from
+        `self.zone_of` (wired to the cluster layout by the composition
+        root); without it the order degrades to self-then-rtt."""
         our_zone = self.zone_of(self.our_id) if self.zone_of else None
 
         def key(n: bytes):
             if n == self.our_id:
-                return (0, 0, 0.0, n)
+                return (0, 0, 0, 0.0, n)
+            sick = 1 if self.health.is_sick(n) else 0
             other_zone = (
                 1 if our_zone is None or self.zone_of(n) != our_zone else 0
             )
-            rtt = self.peering.peer_avg_rtt(n)
-            return (1, other_zone, rtt if rtt is not None else 9.0, n)
+            # one RTT view for ordering AND adaptive timeouts: the health
+            # EWMA sees every RPC outcome plus pings; peering's ping-only
+            # average is the cold-start fallback
+            rtt = self.health.rtt_of(n)
+            if rtt is None:
+                rtt = self.peering.peer_avg_rtt(n)
+            return (1, sick, other_zone, rtt if rtt is not None else 9.0, n)
 
         return sorted(nodes, key=key)
 
@@ -74,14 +114,109 @@ class RpcHelper:
         prio: int = PRIO_NORMAL,
         timeout: float | None = None,
         stream_factory=None,
+        idempotent: bool = False,
+        max_attempts: int = 3,
+        order_tag=None,
     ):
-        """stream_factory() makes a FRESH attached byte stream per call —
+        """One health-tracked RPC.
+
+        stream_factory() makes a FRESH attached byte stream per call —
         required because an async iterator can only be consumed once but a
-        quorum write sends the same payload to several nodes."""
-        return await endpoint.call(
-            node, msg, prio=prio, timeout=timeout or self.default_timeout,
-            stream=stream_factory() if stream_factory else None,
-        )
+        quorum write sends the same payload to several nodes (and a retry
+        resends it).
+
+        Breaker: calls to a peer whose circuit is open raise
+        PeerUnavailable immediately instead of burning a timeout.  Unless
+        the caller pinned `timeout`, the per-call timeout adapts to the
+        peer's observed RTT (a historically-fast peer fails in ~1 s, not
+        `default_timeout`).
+
+        `idempotent=True` enables jittered-exponential retry (up to
+        `max_attempts` total tries) on TRANSPORT failures only — reads
+        and other at-least-once-safe calls; application errors
+        (RemoteError) never retry."""
+        backoff = Backoff(RETRY_BASE, RETRY_MAX)
+        attempts = max(1, max_attempts) if idempotent else 1
+        lbl = (("endpoint", endpoint.path),)
+        last_exc: Exception | None = None
+        for attempt in range(attempts):
+            if attempt:
+                registry.incr("rpc_retry_counter", lbl)
+                await asyncio.sleep(backoff.next())
+            try:
+                return await self._call_once(
+                    endpoint, node, msg, prio, timeout, stream_factory,
+                    order_tag,
+                )
+            except PeerUnavailable as e:
+                # fast-fail is cheap; retrying it is pointless until the
+                # breaker half-opens, which takes longer than our backoff
+                raise e
+            except (asyncio.TimeoutError, ConnectionClosed, OSError) as e:
+                last_exc = e
+            except Exception as e:  # noqa: BLE001
+                if isinstance(e, RemoteError) or not _is_transport_error(e):
+                    raise
+                last_exc = e
+        assert last_exc is not None
+        raise last_exc
+
+    async def _call_once(
+        self, endpoint, node, msg, prio, timeout, stream_factory,
+        order_tag=None,
+    ):
+        if node == self.our_id:
+            # local shortcut: no transport involved, health not consulted
+            return await endpoint.call(
+                node, msg, prio=prio, timeout=timeout or self.default_timeout,
+                stream=stream_factory() if stream_factory else None,
+                order_tag=order_tag,
+            )
+        health = self.health
+        # raises PeerUnavailable when the circuit is open; True = this
+        # call owns the half-open probe slot and must release it if it
+        # ends without a verdict
+        is_probe = health.acquire(node)
+        if timeout is not None:
+            eff_timeout = timeout
+        elif is_probe:
+            # the half-open probe gets the full default timeout: it must
+            # be able to CLOSE the breaker even when the adaptive window
+            # has collapsed below the peer's current response time
+            eff_timeout = self.default_timeout
+        else:
+            eff_timeout = health.adaptive_timeout(node, self.default_timeout)
+        t0 = time.perf_counter()
+        try:
+            resp = await endpoint.call(
+                node, msg, prio=prio, timeout=eff_timeout,
+                stream=stream_factory() if stream_factory else None,
+                order_tag=order_tag,
+            )
+        except RemoteError:
+            # the peer answered (with an application error): transport is
+            # healthy — feed the breaker a success, re-raise for the caller
+            health.record_success(
+                node, time.perf_counter() - t0, probe=is_probe
+            )
+            raise
+        except asyncio.CancelledError:
+            if is_probe:
+                health.release(node)  # no verdict: free the probe slot
+            raise
+        except Exception as e:  # noqa: BLE001
+            if isinstance(e, asyncio.TimeoutError):
+                # widen the peer's adaptive window (TCP-RTO style)
+                health.record_failure(
+                    node, timed_out_after=eff_timeout, probe=is_probe
+                )
+            elif _is_transport_error(e):
+                health.record_failure(node, probe=is_probe)
+            elif is_probe:
+                health.release(node)
+            raise
+        health.record_success(node, time.perf_counter() - t0, probe=is_probe)
+        return resp
 
     async def call_many(
         self,
@@ -125,7 +260,8 @@ class RpcHelper:
         lbl = (("endpoint", endpoint.path),)
         if quorum > len(nodes):
             _quorum_fail(lbl, quorum, 0, [f"only {len(nodes)} candidate nodes"])
-        timeout = timeout or self.default_timeout
+        # `timeout` stays None unless the caller pinned it, so each
+        # per-node call gets its adaptive (RTT-derived) timeout
 
         results: list[Any] = []
         errors: list[str] = []
@@ -195,8 +331,18 @@ class RpcHelper:
     ) -> None:
         """Write to the union of all sets; success when EVERY set has
         `quorum` successes.  Remaining in-flight requests are left running
-        in the background (they still deliver the write to slow nodes)."""
-        timeout = timeout or self.default_timeout
+        in the background (they still deliver the write to slow nodes).
+
+        Per-node calls are PINNED to the full timeout, not the adaptive
+        RTT-derived one: writes carry whole payloads (block PUT streams),
+        and the call only completes once the peer has ingested the entire
+        stream — judging that by a ping-scale RTT window would abort
+        slow-but-healthy writes and feed their failures to the breaker
+        (the EC put path in block/manager.py pins its sends for the same
+        reason).  Reads (try_call_many) keep adaptive timeouts: their
+        responses are latency-bound, and a stuck read has cheap fallback
+        nodes."""
+        overall_timeout = timeout if timeout is not None else self.default_timeout
         lbl = (("endpoint", endpoint.path),)
         if not write_sets or all(not s for s in write_sets):
             _quorum_fail(lbl, quorum, 0, ["no write sets (layout has no nodes yet)"])
@@ -231,7 +377,8 @@ class RpcHelper:
         async def one(n: bytes):
             try:
                 await self.call(
-                    endpoint, n, msg, prio, timeout, stream_factory=stream_factory
+                    endpoint, n, msg, prio, overall_timeout,
+                    stream_factory=stream_factory,
                 )
                 for i, s in enumerate(write_sets):
                     if n in s:
@@ -246,7 +393,7 @@ class RpcHelper:
 
         tasks = [asyncio.create_task(one(n)) for n in all_nodes]
         try:
-            await asyncio.wait_for(done_ev.wait(), timeout + 5.0)
+            await asyncio.wait_for(done_ev.wait(), overall_timeout + 5.0)
         except asyncio.TimeoutError:
             pass
         if not sets_satisfied():

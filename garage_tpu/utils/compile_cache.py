@@ -16,6 +16,12 @@ situation.  The background banker (`script/tpu_bank.py`) git-commits
 window populates it, the directory is empty and every entry is a miss
 (stale entries are also just misses, never wrong results).
 
+The cache is only enabled on NON-CPU backends: CPU compiles are cheap and
+can't wedge, and CPU-routed probes/fallback children used to accrete
+CPU-backend entries into the committed accelerator cache, bloating every
+artifact commit for zero benefit.  `enable_persistent_cache` is therefore
+a no-op (returns "") when the process resolves to the CPU backend.
+
 Reference analog: none (the reference is interpreted Rust; its hot loops
 don't have a compile step).  This is TPU-operational plumbing.
 """
@@ -34,15 +40,23 @@ def enable_persistent_cache(path: str | None = None) -> str:
     """Idempotently enable the persistent compilation cache.
 
     Must be called before (or after — jax.config is live) the first jit
-    compile to have effect on it.  Returns the cache dir in use.
+    compile to have effect on it.  Returns the cache dir in use, or ""
+    when disabled (CPU backend: see module docstring).
     """
     global _enabled
     path = path or os.environ.get("GARAGE_XLA_CACHE_DIR", DEFAULT_CACHE_DIR)
     if _enabled:
         return path
-    os.makedirs(path, exist_ok=True)
+    # cheap env check first: CPU-pinned children (bench.py cpu_env, the
+    # test suite) never initialize a backend just to learn it's cpu
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return ""
 
     import jax
+
+    if jax.default_backend() == "cpu":
+        return ""
+    os.makedirs(path, exist_ok=True)
 
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache EVERYTHING: the default thresholds skip small/fast compiles,

@@ -10,7 +10,11 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
+
+try:  # py3.11+ stdlib; absent on 3.10 containers — only read_config needs it
+    import tomllib
+except ImportError:
+    tomllib = None
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -197,9 +201,113 @@ def _get_secret(
     return inline
 
 
+def _parse_toml_minimal(text: str) -> dict[str, Any]:
+    """Fallback TOML-subset parser for interpreters without tomllib
+    (python < 3.11 containers): comments, [dotted.sections], and
+    `key = value` with string / int / float / bool / single-line array
+    values — the full shape of garage config files.  Anything fancier
+    raises rather than guessing."""
+
+    def scalar(tok: str):
+        tok = tok.strip()
+        if len(tok) >= 2 and tok[0] == tok[-1] and tok[0] in "\"'":
+            body = tok[1:-1]
+            if tok[0] == '"':
+                body = (
+                    body.replace("\\\\", "\x00")
+                    .replace('\\"', '"')
+                    .replace("\\n", "\n")
+                    .replace("\\t", "\t")
+                    .replace("\x00", "\\")
+                )
+            return body
+        if tok in ("true", "false"):
+            return tok == "true"
+        try:
+            return int(tok)
+        except ValueError:
+            pass
+        try:
+            return float(tok)
+        except ValueError:
+            raise ValueError(f"unsupported TOML value {tok!r}") from None
+
+    def split_csv(body: str) -> list[str]:
+        out, cur, quote = [], "", None
+        for ch in body:
+            if quote:
+                cur += ch
+                if ch == quote and not cur.endswith("\\" + quote):
+                    quote = None
+            elif ch in "\"'":
+                quote = ch
+                cur += ch
+            elif ch == ",":
+                out.append(cur)
+                cur = ""
+            else:
+                cur += ch
+        if cur.strip():
+            out.append(cur)
+        return out
+
+    root: dict[str, Any] = {}
+    table = root
+    for lineno, raw_line in enumerate(text.splitlines(), 1):
+        line = raw_line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            if not line.endswith("]") or line.startswith("[["):
+                raise ValueError(f"line {lineno}: unsupported section {line!r}")
+            table = root
+            for part in line[1:-1].strip().split("."):
+                table = table.setdefault(part.strip(), {})
+            continue
+        key, eq, val = line.partition("=")
+        if not eq:
+            raise ValueError(f"line {lineno}: expected key = value, got {line!r}")
+        key = key.strip()
+        target = table
+        if key.startswith('"') and key.endswith('"'):
+            key = key[1:-1]  # quoted key: dots are literal
+        elif "." in key:
+            # dotted key nests, exactly like tomllib ('a.b = 1' ->
+            # {'a': {'b': 1}}) — storing the literal "a.b" would make the
+            # same file parse differently on py3.11 vs the fallback
+            *parents, key = [part.strip().strip('"') for part in key.split(".")]
+            for part in parents:
+                target = target.setdefault(part, {})
+        val = val.strip()
+        # strip a trailing comment: first '#' OUTSIDE any quoted string
+        quote = None
+        for i, ch in enumerate(val):
+            if quote:
+                if ch == quote and val[i - 1] != "\\":
+                    quote = None
+            elif ch in "\"'":
+                quote = ch
+            elif ch == "#":
+                val = val[:i].strip()
+                break
+        if val.startswith("["):
+            if not val.endswith("]"):
+                raise ValueError(
+                    f"line {lineno}: multi-line arrays need python >= 3.11"
+                )
+            target[key] = [scalar(t) for t in split_csv(val[1:-1])]
+        else:
+            target[key] = scalar(val)
+    return root
+
+
 def read_config(path: str) -> Config:
-    with open(path, "rb") as f:
-        raw = tomllib.load(f)
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+    else:
+        with open(path, encoding="utf-8") as f:
+            raw = _parse_toml_minimal(f.read())
     return config_from_dict(raw)
 
 

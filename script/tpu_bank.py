@@ -10,8 +10,7 @@ subprocess:
   1. bench.py            (encode ladder — banks the headline number)
   2. bench.py --repair   (reconstruction dial)
   3. bench.py --hash     (fused encode+BLAKE3 at production batch)
-  4. bench_repair_storage.py (storage-side bulk_reconstruct, TPU upgrade)
-  5. script/tpu_verify.py (on-chip bit-exactness suite)
+  4. script/tpu_verify.py (on-chip bit-exactness suite)
 
 All stdout/stderr goes to tpu_runs/bank_<ts>.log with UTC timestamps, and
 the winning JSON lines to tpu_runs/banked_<ts>.json.  After any window
@@ -55,12 +54,25 @@ def run(f, tag, cmd, timeout):
 
 def git_commit_artifacts(f, msg):
     """Commit tpu_runs/ + .xla_cache/ only (explicit pathspecs, so a
-    concurrently-working builder's staged files are never swept in)."""
+    concurrently-working builder's staged files are never swept in).
+    Each path is added SEPARATELY: `git add` with several pathspecs is
+    atomic, so one empty/untracked dir (a cold `.xla_cache/`) used to
+    fatal the whole add and silently skip the durability commit."""
     paths = ["tpu_runs", ".xla_cache"]
     try:
-        subprocess.run(["git", "add", "-A", "--"] + paths, cwd=REPO,
-                       capture_output=True, timeout=60)
-        r = subprocess.run(["git", "commit", "-m", msg, "--"] + paths,
+        added = []
+        for p in paths:
+            r = subprocess.run(["git", "add", "-A", "--", p], cwd=REPO,
+                               capture_output=True, text=True, timeout=60)
+            if r.returncode != 0:
+                log(f, f"git add {p} rc={r.returncode}: "
+                       f"{(r.stderr or '').strip()[:200]}")
+            else:
+                added.append(p)
+        if not added:
+            log(f, "git add matched nothing; skipping commit")
+            return
+        r = subprocess.run(["git", "commit", "-m", msg, "--"] + added,
                            cwd=REPO, capture_output=True, text=True,
                            timeout=60)
         log(f, f"git commit rc={r.returncode}: {(r.stdout or '').strip()[:200]}")
@@ -108,8 +120,6 @@ def main():
                 ("encode", [py, "bench.py", "--verbose"], 600),
                 ("repair", [py, "bench.py", "--repair", "--verbose"], 600),
                 ("hash", [py, "bench.py", "--hash", "--verbose"], 600),
-                ("storage_repair",
-                 [py, "bench_repair_storage.py", "--blocks", "2048"], 600),
             ]
             for name, cmd, tmo in dials:
                 rc, out = run(f, name, cmd, tmo)

@@ -466,6 +466,20 @@ def test_admin_api_connect_health_nodeinfo(tmp_path):
                     assert res[0] == {"success": True, "error": None}
                     assert res[1]["success"] is False and res[1]["error"]
                 assert garage.netapp.is_connected(garage2.node_id)
+
+                # peer health (PR 1): after traffic to node1, /v1/status
+                # reports the breaker/EWMA view of that peer
+                await garage.helper_rpc.call(
+                    garage.system.status_ep, garage2.node_id,
+                    garage.system.local_status().to_obj(),
+                )
+                async with sess.get(base + "/v1/status") as r:
+                    assert r.status == 200
+                    st = await r.json()
+                    by_id = {n["id"]: n for n in st["nodes"]}
+                    rh = by_id[hex_of(garage2.node_id)]["rpcHealth"]
+                    assert rh is not None and rh["state"] == "closed"
+                    assert rh["successes"] >= 1
         finally:
             await adm.stop()
             await teardown(garage2, s32)

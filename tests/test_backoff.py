@@ -1,0 +1,65 @@
+"""utils/backoff.py: the ONE shared exponential-backoff implementation
+(resync error ladder, peering reconnect pacing, RPC idempotent retries)."""
+
+import random
+
+from garage_tpu.utils.backoff import Backoff, expo, jittered
+
+
+def test_expo_growth_and_cap():
+    assert expo(0, 1.0, 60.0) == 1.0
+    assert expo(1, 1.0, 60.0) == 2.0
+    assert expo(5, 1.0, 60.0) == 32.0
+    assert expo(6, 1.0, 60.0) == 60.0  # capped
+    assert expo(50, 1.0, 60.0) == 60.0  # stays capped, no overflow
+    assert expo(10_000, 1.0, 60.0) == 60.0  # huge counts don't blow up
+    assert expo(-3, 1.0, 60.0) == 1.0  # negative counts clamp to base
+
+
+def test_jitter_bounds():
+    rng = random.Random(1234)
+    draws = [jittered(10.0, rng) for _ in range(2000)]
+    assert all(7.5 <= d < 12.5 for d in draws), (min(draws), max(draws))
+    # jitter actually spreads (not a constant factor)
+    assert max(draws) - min(draws) > 3.0
+
+
+def test_backoff_reset_on_success():
+    b = Backoff(base=0.1, max_=10.0, rng=random.Random(7))
+    first = b.next()
+    second = b.next()
+    third = b.next()
+    # growing (jitter windows for successive attempts cannot overlap at
+    # factor 2 with spread 0.5: [0.75x, 1.25x) vs [1.5x, 2.5x))
+    assert first < second < third
+    b.reset()
+    again = b.next()
+    assert 0.075 <= again < 0.125, "reset must return pacing to the base"
+
+
+def test_backoff_cap_at_max():
+    b = Backoff(base=1.0, max_=4.0, rng=random.Random(9))
+    for _ in range(20):
+        d = b.next()
+    # capped at max_ (modulo the jitter window around it)
+    assert d <= 4.0 * 1.25
+    assert d >= 4.0 * 0.75
+
+
+def test_resync_ladder_regression():
+    """block/resync.py moved from an inline formula to expo(); the error
+    ladder must be bit-identical: 1 min -> 64 min, doubling, capped."""
+    BACKOFF_MIN_MS = 60 * 1000
+    BACKOFF_MAX_MS = 64 * 60 * 1000
+    for count in range(0, 101):
+        old = min(BACKOFF_MAX_MS, BACKOFF_MIN_MS * (2 ** min(count, 6)))
+        new = int(expo(count, BACKOFF_MIN_MS, BACKOFF_MAX_MS))
+        assert new == old, (count, new, old)
+
+
+def test_peering_connect_ladder_regression():
+    """net/peering.py reconnect delays: same 1 s -> 60 s envelope as the
+    old inline formula (jitter aside)."""
+    for failures in range(1, 20):
+        old = min(60.0, 1.0 * (2 ** min(failures, 6)))
+        assert expo(failures, 1.0, 60.0) == old, failures

@@ -170,6 +170,10 @@ def test_pallas_kernel_lowers_for_tpu():
     import jax
     import jax.numpy as jnp
 
+    # `jax.export` as an attribute is deprecated-then-removed on newer
+    # jax; the submodule import works on every version that has it
+    from jax import export as jax_export
+
     from garage_tpu.ops.ec_tpu import gf_bitmatmul_pallas
 
     k, m = 8, 3
@@ -179,7 +183,7 @@ def test_pallas_kernel_lowers_for_tpu():
     x = jnp.zeros((4, k, 16384), jnp.uint8)
     for dd in ("int8", "bf16"):
         for bm in (enc, rec):
-            exported = jax.export.export(
+            exported = jax_export.export(
                 jax.jit(lambda b, xx, _dd=dd: gf_bitmatmul_pallas(b, xx, dot_dtype=_dd)),
                 platforms=["tpu"],
             )(bm, x)

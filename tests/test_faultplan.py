@@ -1,0 +1,178 @@
+"""net/fault.py: the seedable fault-injection plane.
+
+Determinism is the point: the same seed must replay the same injected
+fault sequence, so a chaos-test failure is reproducible from its logged
+seed."""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_net import make_node  # noqa: E402
+
+from garage_tpu.net.fault import FaultPlan, FaultRule  # noqa: E402
+from garage_tpu.net.message import Req, Resp  # noqa: E402
+from garage_tpu.net.stream import (  # noqa: E402
+    StreamError,
+    bytes_stream,
+    read_stream_to_end,
+)
+
+A = b"\x0a" * 32
+B = b"\x0b" * 32
+
+
+def drive(plan: FaultPlan) -> list:
+    """A fixed decision sequence; returns the trace."""
+    for _ in range(50):
+        plan.rpc_delay(A)
+        plan.should_drop(A)
+        plan.should_drop(B)
+        plan.should_fail_disk("write")
+        plan.should_fail_disk("read")
+    return plan.trace
+
+
+def test_same_seed_same_fault_sequence():
+    rule = FaultRule(
+        latency_ms=10, jitter_ms=5, drop=0.3,
+        disk_write_fail=0.2, disk_read_fail=0.1,
+    )
+    t1 = drive(FaultPlan(42).set_rule(rule))
+    t2 = drive(FaultPlan(42).set_rule(rule))
+    assert t1 == t2, "same seed must replay the same decisions"
+    assert len(t1) == 250
+    # the sequence is non-trivial: both outcomes of `drop` occur
+    drops = [out for op, _p, out in t1 if op == "drop"]
+    assert True in drops and False in drops
+
+
+def test_different_seed_different_sequence():
+    rule = FaultRule(latency_ms=10, jitter_ms=5, drop=0.3)
+    t1 = drive(FaultPlan(1).set_rule(rule))
+    t2 = drive(FaultPlan(2).set_rule(rule))
+    assert t1 != t2
+
+
+def test_per_peer_rules_vs_default():
+    plan = FaultPlan(7)
+    plan.set_rule(FaultRule(drop=1.0), peer=A)
+    assert plan.should_drop(A) is True
+    assert plan.should_drop(B) is False  # no default rule -> no fault
+    plan.set_rule(FaultRule(drop=1.0))  # default for everyone else
+    assert plan.should_drop(B) is True
+
+
+def test_injected_latency_delays_calls():
+    async def main():
+        a, b = await make_node(), await make_node()
+        try:
+            b.endpoint("f/echo").set_handler(
+                lambda _f, req: _resp(req.body)
+            )
+            await a.connect(b.bind_addr, b.id)
+            # baseline
+            t0 = asyncio.get_event_loop().time()
+            await a.endpoint("f/echo").call(b.id, 1)
+            base = asyncio.get_event_loop().time() - t0
+            # 120 ms injected latency toward b
+            a.fault_plan = FaultPlan(3).set_rule(
+                FaultRule(latency_ms=120), peer=b.id
+            )
+            t0 = asyncio.get_event_loop().time()
+            await a.endpoint("f/echo").call(b.id, 1)
+            slow = asyncio.get_event_loop().time() - t0
+            assert slow > base + 0.1
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    asyncio.run(main())
+
+
+def test_drop_hangs_until_caller_timeout():
+    """A dropped request behaves like a lost packet: the CALLER's timeout
+    fires (that is what exercises adaptive timeouts + the breaker), it is
+    not a fast error."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+        try:
+            b.endpoint("f/echo").set_handler(lambda _f, req: _resp(req.body))
+            await a.connect(b.bind_addr, b.id)
+            a.fault_plan = FaultPlan(5).set_rule(
+                FaultRule(drop=1.0), peer=b.id
+            )
+            t0 = asyncio.get_event_loop().time()
+            with pytest.raises(asyncio.TimeoutError):
+                await a.endpoint("f/echo").call(b.id, 1, timeout=0.3)
+            dt = asyncio.get_event_loop().time() - t0
+            assert 0.25 <= dt < 2.0, dt
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    asyncio.run(main())
+
+
+def test_stream_truncation_mid_transfer():
+    """A served response stream cut by the nemesis surfaces as a
+    StreamError at the consumer, after SOME chunks were delivered."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+        try:
+            payload = os.urandom(1024 * 1024)
+
+            async def handler(_f, req):
+                return Resp("data", stream=bytes_stream(payload, chunk=64 * 1024))
+
+            b.endpoint("f/blob").set_handler(handler)
+            await a.connect(b.bind_addr, b.id)
+            # sanity: full read without the nemesis
+            resp = await a.endpoint("f/blob").call(b.id, None)
+            assert await read_stream_to_end(resp.stream) == payload
+            # serving node b truncates streams it serves to a
+            b.fault_plan = FaultPlan(11).set_rule(
+                FaultRule(truncate=1.0), peer=a.id
+            )
+            resp = await a.endpoint("f/blob").call(b.id, None)
+            got = 0
+            # the producer-side cut crosses the wire as a CANCEL frame, so
+            # the consumer sees a StreamError ("cancelled by peer")
+            with pytest.raises(StreamError):
+                async for chunk in resp.stream:
+                    got += len(chunk)
+            assert got < len(payload)
+            assert ("truncate", a.id.hex()[:8], True) in b.fault_plan.trace
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    asyncio.run(main())
+
+
+def test_local_calls_never_faulted():
+    """The fault plane models the NETWORK + disk, not the local shortcut:
+    a node calling its own endpoint is unaffected."""
+
+    async def main():
+        a = await make_node()
+        try:
+            a.endpoint("f/self").set_handler(lambda _f, req: _resp("ok"))
+            a.fault_plan = FaultPlan(1).set_rule(FaultRule(drop=1.0))
+            resp = await a.endpoint("f/self").call(a.id, None, timeout=0.5)
+            assert resp.body == "ok"
+            assert a.fault_plan.trace == []
+        finally:
+            await a.shutdown()
+
+    asyncio.run(main())
+
+
+async def _resp(body):
+    return Resp(body)

@@ -257,7 +257,12 @@ async def check_set2(hist: History, client):
     tried_del = {o["key"] for o in hist.ops if o["op"] == "delete"}
     acked_del = {o["key"] for o in hist.ops if o["op"] == "delete" and o["ok"]}
     required = acked_ins - tried_del
-    deadline = time.monotonic() + 30
+    # post-heal convergence can legitimately take tens of seconds now:
+    # the circuit breaker (PR 1) fast-fails a healed peer for up to its
+    # cooldown, during which sync/queue workers sink toward the worker
+    # supervisor's 64 s max error backoff — the deadline must exceed
+    # that cap, or a slow box flakes without any invariant violation
+    deadline = time.monotonic() + 75
     missing = phantom = None
     while time.monotonic() < deadline:
         listing = await client.list_objects_v2("jepsen", prefix="set-")
@@ -352,7 +357,9 @@ def _run_jepsen(tmp_path, mode):
             for i in range(N_REG_KEYS):
                 k = f"reg-{i}"
                 last = max((w["ver"] for w in hist.acked_writes(k)), default=0)
-                deadline = time.monotonic() + 30
+                # 75 s: must exceed the worker supervisor's 64 s max error
+                # backoff — see the comment in check_set2
+                deadline = time.monotonic() + 75
                 got = -1
                 while time.monotonic() < deadline:
                     try:

@@ -133,13 +133,14 @@ def test_reflection_attack_rejected():
         import hmac as hmac_mod
         import struct
 
-        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        # the attacker uses the same primitives the node does (real
+        # cryptography when installed, the stdlib fallback otherwise)
+        from garage_tpu.net import handshake as hs
+        from garage_tpu.net.crypto_compat import (
+            ChaCha20Poly1305,
             X25519PrivateKey,
             X25519PublicKey,
         )
-        from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-
-        from garage_tpu.net import handshake as hs
 
         netkey = NETKEY
 
