@@ -1,14 +1,20 @@
 #!/usr/bin/env python3
 """S3 PUT/GET latency benchmark: erasure-coded vs replicated block store.
 
-BASELINE.md north star: "S3 PUT p99 <= 1.2x of 3-replica mode".  Boots two
-in-process 3-node clusters (replication "3" and EC(2,1)), drives identical
-PUT+GET workloads through the real S3 HTTP API, and reports p50/p99 from
-the api_s3_request_duration latency histograms (utils/metrics.py).
+BASELINE.md north star: "S3 PUT p99 <= 1.2x of 3-replica mode" at the
+north-star geometry — EC(8,3), 1 MiB objects (VERDICT Missing #3 wanted
+exactly this configuration measured, not the ec:2:1/64 KiB proxy this
+bench used to run).  Boots a 3-node replication-"3" cluster and an
+11-node EC(8,3) cluster (k+m = 11 pieces need 11 storage nodes), drives
+identical PUT+GET workloads through the real S3 HTTP API, and reports
+client-side wall-time percentiles.
 
-    python bench_s3.py [--objects 200] [--size 65536]
+    python bench_s3.py [--objects 200] [--size 1048576] \
+        [--artifact BENCH_s3_geometry.json]
 
-Prints ONE JSON line: {"metric": "s3_put_p99_ec_over_replica", ...}.
+Prints ONE JSON line: {"metric": "s3_put_p99_ec_over_replica", ...};
+--artifact also writes it to a committed JSON file so the driver can read
+the EC-vs-replica PUT p99 ratio without scraping stdout.
 Runs on CPU (numpy codec) — the ratio isolates protocol overhead, which is
 what the target bounds; absolute GB/s lives in bench.py.
 """
@@ -27,14 +33,14 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
 
 
-async def boot_bench_cluster(tmp_path, mode: str):
-    """3-node cluster + S3 server on node0 + an authorized client."""
+async def boot_bench_cluster(tmp_path, mode: str, n: int = 3, block_size: int = 65536):
+    """n-node cluster + S3 server on node0 + an authorized client."""
     from test_ec_cluster import make_ec_cluster
 
     from garage_tpu.api.s3.api_server import S3ApiServer
     from garage_tpu.api.s3.client import S3Client
 
-    garages = await make_ec_cluster(tmp_path, n=3, mode=mode, block_size=65536)
+    garages = await make_ec_cluster(tmp_path, n=n, mode=mode, block_size=block_size)
     s3 = S3ApiServer(garages[0])
     await s3.start("127.0.0.1", 0)
     ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
@@ -50,12 +56,17 @@ def _pct(xs: list[float], q: float) -> float:
     return xs[min(len(xs) - 1, int(q * len(xs)))]
 
 
-async def run_cluster(tmp_path, mode: str, n_objects: int, size: int) -> dict:
+async def run_cluster(
+    tmp_path, mode: str, n_objects: int, size: int, n_nodes: int = 3,
+    block_size: int = 65536,
+) -> dict:
     import time
 
     from test_ec_cluster import stop_cluster
 
-    garages, s3, client = await boot_bench_cluster(tmp_path, mode)
+    garages, s3, client = await boot_bench_cluster(
+        tmp_path, mode, n=n_nodes, block_size=block_size
+    )
     try:
         await client.create_bucket("bench")
         body = os.urandom(size)
@@ -129,7 +140,15 @@ async def run_bigget(tmp_path, size: int, depths: list[int]) -> dict:
 async def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--objects", type=int, default=200)
-    ap.add_argument("--size", type=int, default=64 * 1024)
+    ap.add_argument("--size", type=int, default=1024 * 1024)
+    ap.add_argument("--ec", default="ec:8:3", help="EC geometry under test")
+    ap.add_argument(
+        "--block-size", type=int, default=1024 * 1024,
+        help="cluster block size (north star: 1 MiB)",
+    )
+    ap.add_argument(
+        "--artifact", help="also write the JSON result to this path"
+    )
     ap.add_argument("--bigget", action="store_true")
     ap.add_argument("--big-size", type=int, default=100 * 1024 * 1024)
     args = ap.parse_args()
@@ -160,17 +179,23 @@ async def main() -> None:
         )
         return
 
-    with tempfile.TemporaryDirectory() as d1:
-        import pathlib
+    import pathlib
+    import re
 
+    m = re.fullmatch(r"ec:(\d+):(\d+)", args.ec)
+    if not m:
+        raise SystemExit(f"bad --ec {args.ec!r}, want ec:k:m")
+    k, mm = int(m.group(1)), int(m.group(2))
+    with tempfile.TemporaryDirectory() as d1:
         rep = await run_cluster(
-            pathlib.Path(d1), "3", args.objects, args.size
+            pathlib.Path(d1), "3", args.objects, args.size,
+            n_nodes=3, block_size=args.block_size,
         )
     with tempfile.TemporaryDirectory() as d2:
-        import pathlib
-
+        # EC(k,m) stores k+m distinct pieces per block -> k+m storage nodes
         ec = await run_cluster(
-            pathlib.Path(d2), "ec:2:1", args.objects, args.size
+            pathlib.Path(d2), args.ec, args.objects, args.size,
+            n_nodes=k + mm, block_size=args.block_size,
         )
 
     ratio = (
@@ -178,28 +203,33 @@ async def main() -> None:
         if rep["put_p99"] and ec["put_p99"]
         else None
     )
-    print(
-        json.dumps(
-            {
-                "metric": "s3_put_p99_ec_over_replica",
-                "value": round(ratio, 3) if ratio else None,
-                "unit": "ratio",
-                "vs_baseline": round(1.2 / ratio, 3) if ratio else None,
-                "detail": {
-                    "replica_ms": {
-                        k: round(v * 1000, 2) if v else None
-                        for k, v in rep.items()
-                    },
-                    "ec21_ms": {
-                        k: round(v * 1000, 2) if v else None
-                        for k, v in ec.items()
-                    },
-                    "objects": args.objects,
-                    "size": args.size,
-                },
-            }
-        )
-    )
+    result = {
+        "metric": "s3_put_p99_ec_over_replica",
+        "value": round(ratio, 3) if ratio else None,
+        "unit": "ratio",
+        "vs_baseline": round(1.2 / ratio, 3) if ratio else None,
+        "detail": {
+            "geometry": args.ec,
+            "replica_nodes": 3,
+            "ec_nodes": k + mm,
+            "replica_ms": {
+                k_: round(v * 1000, 2) if v else None
+                for k_, v in rep.items()
+            },
+            "ec_ms": {
+                k_: round(v * 1000, 2) if v else None
+                for k_, v in ec.items()
+            },
+            "objects": args.objects,
+            "size": args.size,
+            "block_size": args.block_size,
+        },
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.artifact:
+        with open(args.artifact, "w") as f:
+            f.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -199,6 +199,12 @@ def lib() -> ctypes.CDLL | None:
         l.kv_log_bytes.restype = ctypes.c_uint64
         l.kv_live_bytes.argtypes = [ctypes.c_void_p]
         l.kv_live_bytes.restype = ctypes.c_uint64
+        if hasattr(l, "kv_sync_failures"):
+            # telemetry-only symbol, absent from externally-built .so's
+            # (GARAGE_NATIVE_SO) predating it — optional, never a reason
+            # to reject the whole library
+            l.kv_sync_failures.argtypes = [ctypes.c_void_p]
+            l.kv_sync_failures.restype = ctypes.c_uint64
         _lib = l
     except (OSError, AttributeError) as e:
         # AttributeError: an externally-built .so (GARAGE_NATIVE_SO) from

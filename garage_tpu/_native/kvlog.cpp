@@ -40,6 +40,7 @@
 
 #include <algorithm>
 #include <cerrno>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -105,6 +106,11 @@ struct KvDb {
   uint64_t seq_committed = 0;  // frames appended
   uint64_t seq_durable = 0;    // frames covered by an fdatasync
   bool stop_flusher = false;
+  // errno of the last failed flusher sync (0 = healthy).  While nonzero,
+  // seq_durable is frozen and kv_sync_barrier fails fast instead of
+  // waiting on durability that is not being achieved.
+  int sync_err = 0;
+  uint64_t sync_failures = 0;  // cumulative failed flusher sync attempts
 
   ~KvDb() {
     if (fd >= 0) ::close(fd);
@@ -328,15 +334,34 @@ void flusher_main(KvDb* db) {
     }
     uint64_t target = db->seq_committed;
     int sfd = ::dup(db->fd);
+    int err = sfd < 0 ? errno : 0;
     lk.unlock();
+    int rc = -1;
     if (sfd >= 0) {
-      ::fdatasync(sfd);
+      rc = ::fdatasync(sfd);
+      if (rc != 0) err = errno;  // capture before close() can clobber it
       ::close(sfd);
     }
     lk.lock();
-    // a concurrent compact may have advanced seq_durable past target
-    if (sfd >= 0 && target > db->seq_durable) db->seq_durable = target;
-    db->cv.notify_all();
+    if (rc == 0) {
+      db->sync_err = 0;
+      // a concurrent compact may have advanced seq_durable past target
+      if (target > db->seq_durable) db->seq_durable = target;
+      db->cv.notify_all();
+    } else {
+      // dup or fdatasync failed: seq_durable must NOT advance — doing so
+      // would make kv_sync_barrier() report unsynced commits as durable.
+      // Surface the error (barrier waiters fail fast on sync_err) and
+      // pace the retry with a bounded wait instead of busy-spinning on
+      // the still-true wait predicate; a later successful sync (e.g.
+      // after a compaction swapped in a fresh fd) clears the state.
+      db->sync_err = err ? err : EIO;
+      db->sync_failures++;
+      db->cv.notify_all();
+      db->cv.wait_for(lk, std::chrono::milliseconds(50),
+                      [db] { return db->stop_flusher; });
+      if (db->stop_flusher) return;
+    }
   }
 }
 
@@ -386,10 +411,22 @@ int kv_sync_barrier(void* h) {
   if (db->sync_mode == 2 && db->flusher.joinable()) {
     uint64_t target = db->seq_committed;
     db->cv.notify_all();
-    db->cv.wait(lk, [&] { return db->seq_durable >= target; });
-    return 0;
+    // a failing flusher (sync_err set) must surface here, not hang the
+    // barrier forever on durability the disk is refusing to provide
+    db->cv.wait(lk, [&] {
+      return db->seq_durable >= target || db->sync_err != 0;
+    });
+    return db->seq_durable >= target ? 0 : -1;
   }
   return ::fdatasync(db->fd) == 0 ? 0 : -1;
+}
+
+// Flusher health introspection: cumulative failed sync attempts (for
+// metrics/tests; 0 on a healthy handle, or for non-group sync modes).
+uint64_t kv_sync_failures(void* h) {
+  KvDb* db = static_cast<KvDb*>(h);
+  std::lock_guard<std::mutex> lk(db->mu);
+  return db->sync_failures;
 }
 
 // Commit one batch: payload is the concatenated record encoding (exactly

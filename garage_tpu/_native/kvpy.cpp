@@ -32,6 +32,7 @@ size_t kv_iter_chunk(void* h, const char* tree, size_t tlen,
 int kv_compact_now(void* h);
 uint64_t kv_log_bytes(void* h);
 uint64_t kv_live_bytes(void* h);
+uint64_t kv_sync_failures(void* h);
 }
 
 namespace {
@@ -231,6 +232,14 @@ PyObject* py_live_bytes(PyObject*, PyObject* args) {
   return PyLong_FromUnsignedLongLong(kv_live_bytes(h));
 }
 
+PyObject* py_sync_failures(PyObject*, PyObject* args) {
+  PyObject* hobj;
+  if (!PyArg_ParseTuple(args, "O", &hobj)) return nullptr;
+  void* h = handle_of(hobj);
+  if (h == nullptr && PyErr_Occurred()) return nullptr;
+  return PyLong_FromUnsignedLongLong(kv_sync_failures(h));
+}
+
 PyMethodDef methods[] = {
     {"open", py_open, METH_VARARGS, "open(path, fsync) -> handle"},
     {"close", py_close, METH_VARARGS, "close(handle)"},
@@ -246,6 +255,8 @@ PyMethodDef methods[] = {
      "sync_barrier(handle) — wait until all acked commits are durable"},
     {"log_bytes", py_log_bytes, METH_VARARGS, "log_bytes(handle) -> int"},
     {"live_bytes", py_live_bytes, METH_VARARGS, "live_bytes(handle) -> int"},
+    {"sync_failures", py_sync_failures, METH_VARARGS,
+     "sync_failures(handle) -> int — cumulative failed flusher syncs"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -219,7 +219,22 @@ class AdminApiServer:
             # router_v1.rs:102, cluster.rs ClusterHealth struct) — same
             # payload /health serves LBs, but authenticated + always 200
             # so operators can read the *reason* a cluster is unavailable.
-            return web.json_response(g.system.health().__dict__)
+            # Field casing follows the reference admin API (camelCase,
+            # cluster.rs ClusterHealth serde rename_all) like the sibling
+            # /v1/node endpoint.
+            h = g.system.health()
+            return web.json_response(
+                {
+                    "status": h.status,
+                    "knownNodes": h.known_nodes,
+                    "connectedNodes": h.connected_nodes,
+                    "storageNodes": h.storage_nodes,
+                    "storageNodesOk": h.storage_nodes_up,
+                    "partitions": h.partitions,
+                    "partitionsQuorum": h.partitions_quorum,
+                    "partitionsAllOk": h.partitions_all_ok,
+                }
+            )
 
         if path == "/v1/connect" and request.method == "POST":
             # ConnectClusterNodes (reference router_v1.rs:103,

@@ -18,12 +18,23 @@ import logging
 import numpy as np
 
 from ...ops import gf
+from ...utils.metrics import registry
 from .base import BlockCodec
 
 logger = logging.getLogger("garage.block.codec")
 
 SHARD_ALIGN = 64  # blake3 batch hashing wants multiples of 64 bytes
 TPU_BATCH_MIN = 8  # below this, the numpy path wins
+
+
+def _count(op: str, path: str, blocks: int, nbytes: int) -> None:
+    """Codec-layer view of the offload decision: which path (tpu batch vs
+    numpy scalar) actually served how many blocks/bytes.  A production
+    node silently degraded to the scalar path shows up as a rising
+    `path="numpy"` share instead of staying invisible."""
+    lbl = (("op", op), ("path", path))
+    registry.incr("block_codec_blocks_total", lbl, blocks)
+    registry.incr("block_codec_bytes_total", lbl, nbytes)
 
 
 class EcCodec(BlockCodec):
@@ -53,6 +64,9 @@ class EcCodec(BlockCodec):
     # --- scalar API ----------------------------------------------------------
 
     def encode(self, block: bytes) -> list[bytes]:
+        # padded split bytes (k*s), same unit the tpu path and both
+        # reconstruct paths count — the tpu-vs-numpy byte shares compare
+        _count("encode", "numpy", 1, self.k * self.piece_len(len(block)))
         data = self._split(block)  # (k, s)
         parity = gf.apply_matrix(
             gf.cauchy_parity_matrix(self.k, self.m), data
@@ -80,6 +94,7 @@ class EcCodec(BlockCodec):
             )
         use = present[: self.k]
         s = self.piece_len(block_len)
+        _count("reconstruct", "numpy", 1, self.k * s)
         shards = np.stack(
             [np.frombuffer(pieces[i], dtype=np.uint8) for i in use]
         )  # (k, s)
@@ -100,6 +115,7 @@ class EcCodec(BlockCodec):
             groups.setdefault(self.piece_len(len(b)), []).append(idx)
         for s, idxs in groups.items():
             data = np.stack([self._split(blocks[i]) for i in idxs])  # (B,k,s)
+            _count("encode", "tpu", len(idxs), data.nbytes)
             parity = self._tpu.encode(data)  # (B,m,s)
             for j, i in enumerate(idxs):
                 out[i] = [bytes(data[j, x]) for x in range(self.k)] + [
@@ -136,6 +152,7 @@ class EcCodec(BlockCodec):
                     for i in idxs
                 ]
             )  # (B, k, s)
+            _count("reconstruct", "tpu", len(idxs), shards.nbytes)
             rec = self._tpu.reconstruct(shards, list(present), list(want))
             for j, i in enumerate(idxs):
                 out[i] = {w: bytes(rec[j, x]) for x, w in enumerate(want)}

@@ -20,7 +20,6 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
-import logging
 import os
 import signal
 import sys
@@ -173,9 +172,14 @@ def main(argv=None):
 
     args = ap.parse_args(argv)
 
-    logging.basicConfig(
+    from ..utils.log_fmt import setup_logging
+
+    # trace-correlated logging (utils/log_fmt.py): every record under an
+    # active span carries its trace/span ids; GARAGE_LOG_FORMAT=json for
+    # JSON lines.  run_server re-applies this once the config is read.
+    setup_logging(
+        fmt=os.environ.get("GARAGE_LOG_FORMAT", "text"),
         level=os.environ.get("GARAGE_LOG", "INFO"),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
     if args.cmd == "server":
@@ -279,6 +283,12 @@ async def run_server(config_path: str) -> None:
     from .admin_rpc import AdminRpcHandler
 
     config = read_config(config_path)
+    if "GARAGE_LOG_FORMAT" not in os.environ:
+        from ..utils.log_fmt import setup_logging
+
+        setup_logging(
+            fmt=config.log_format, level=os.environ.get("GARAGE_LOG", "INFO")
+        )
     garage = Garage(config)
     await garage.start()
     AdminRpcHandler(garage)

@@ -114,6 +114,11 @@ class _CtypesBinding:
     def live_bytes(self, h) -> int:
         return self._l.kv_live_bytes(h)
 
+    def sync_failures(self, h) -> int:
+        if not hasattr(self._l, "kv_sync_failures"):
+            return 0  # older externally-built .so without the symbol
+        return self._l.kv_sync_failures(h)
+
 
 def _binding():
     from .. import _native

@@ -212,6 +212,11 @@ class Connection:
             "hs": req.stream is not None,
             "ot": req.order_tag.to_obj() if req.order_tag else None,
         }
+        if req.traceparent is not None:
+            # distributed tracing: the serving node parents its handler
+            # span under ours (absent when tracing is off — the wire
+            # format is byte-identical to the untraced one)
+            meta["tp"] = req.traceparent
         credit = None
         if req.stream is not None:
             credit = self._out_credit[rid] = _StreamCredit()
@@ -467,7 +472,11 @@ class Connection:
                 st["writer"] = writer
                 if not st["meta"].get("hs"):
                     await writer.close()  # no attached stream coming
-                req = Req(body, stream=writer.reader())
+                req = Req(
+                    body,
+                    stream=writer.reader(),
+                    traceparent=st["meta"].get("tp"),
+                )
                 st["task"] = asyncio.create_task(self._run_handler(rid, st, req))
             return
         p = self._pending.get(rid)  # response being received (calling side)

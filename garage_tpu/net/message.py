@@ -70,17 +70,24 @@ def new_order_stream() -> OrderTagStream:
 
 
 class Req:
-    """An RPC request: msgpack-able body + optional attached byte stream."""
+    """An RPC request: msgpack-able body + optional attached byte stream.
+
+    `traceparent` (utils/tracing.py inject() bytes) rides the request
+    frame's meta so the serving node can parent its handler span under
+    the caller's trace — None (the common case with tracing off) adds
+    nothing to the wire."""
 
     def __init__(
         self,
         body: Any,
         stream: AsyncIterator[bytes] | None = None,
         order_tag: OrderTag | None = None,
+        traceparent: bytes | None = None,
     ):
         self.body = body
         self.stream = stream
         self.order_tag = order_tag
+        self.traceparent = traceparent
 
 
 class Resp:

@@ -58,16 +58,26 @@ class Endpoint:
         order_tag=None,
     ) -> Resp:
         from ..utils.metrics import registry
-        from ..utils.tracing import span
+        from ..utils.tracing import NOOP_SPAN, tracer
 
         lbl = (("endpoint", self.path),)
         registry.incr("rpc_request_counter", lbl + (("to", target.hex()[:16]),))
-        with span("rpc:" + self.path, to=target.hex()[:16]):
+        # NOOP_SPAN when disabled: the hot path allocates no span, no
+        # name string, no attr dict (asserted by test_observability.py)
+        cm = (
+            tracer.span("rpc:" + self.path, to=target.hex()[:16])
+            if tracer.enabled
+            else NOOP_SPAN
+        )
+        with cm:
+            req = Req(msg, stream=stream, order_tag=order_tag)
+            if tracer.enabled:
+                # inside the rpc span: the remote handler becomes ITS child
+                req.traceparent = tracer.inject()
             with registry.timer("rpc_request_duration", lbl):
                 try:
                     return await self.netapp.call(
-                        target, self.path,
-                        Req(msg, stream=stream, order_tag=order_tag),
+                        target, self.path, req,
                         prio=prio, timeout=timeout,
                     )
                 except asyncio.TimeoutError:
@@ -117,9 +127,22 @@ class NetApp:
         if ep is None or ep.handler is None:
             raise RpcError(f"no handler for endpoint {path!r}")
         from ..utils.metrics import registry
-        from ..utils.tracing import span
+        from ..utils.tracing import NOOP_SPAN, tracer
 
-        with span("rpc-handle:" + path, from_=from_id.hex()[:16]):
+        # remote-parent extraction: a request arriving over the wire joins
+        # the caller's trace (one trace id per logical request across the
+        # whole mesh); the local-shortcut path parents via contextvars
+        cm = (
+            tracer.span(
+                "rpc-handle:" + path,
+                remote_parent=tracer.extract(req.traceparent),
+                from_=from_id.hex()[:16],
+                node=self.id.hex()[:16],
+            )
+            if tracer.enabled
+            else NOOP_SPAN
+        )
+        with cm:
             with registry.timer("rpc_handle_duration", (("endpoint", path),)):
                 resp = await ep.handler(from_id, req)
         if (

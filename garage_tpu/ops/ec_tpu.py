@@ -38,11 +38,10 @@ against the numpy LUT reference in tests/test_ec.py.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
-from . import gf
+from ..utils.compile_cache import instrumented_cache, record_cache_event
+from . import gf, telemetry
 
 
 def _jax():
@@ -187,7 +186,7 @@ def _ec_body(plat: str, impl: str | None):
     return body
 
 
-@functools.lru_cache(maxsize=None)
+@instrumented_cache("ec_apply")
 def ec_apply_fn(platform: str | None = None, impl: str | None = None):
     """Jitted `fn(bitmat_uint8, x_uint8) -> out_uint8`, cached per
     (platform, impl).  impl: None = auto (Pallas on TPU, einsum elsewhere),
@@ -200,7 +199,7 @@ def ec_apply_fn(platform: str | None = None, impl: str | None = None):
     return jax.jit(body, **kwargs)
 
 
-@functools.lru_cache(maxsize=None)
+@instrumented_cache("ec_apply_mesh")
 def ec_apply_fn_mesh(
     platform: str | None, impl: str | None, n_devices: int, axis: str = "blocks"
 ):
@@ -229,7 +228,7 @@ def ec_apply_fn_mesh(
 
 
 # legacy alias used by the fused pipeline (portable einsum body)
-@functools.lru_cache(maxsize=None)
+@instrumented_cache("ec_apply_legacy")
 def _apply_fn(platform: str | None):
     jax = _jax()
 
@@ -286,7 +285,14 @@ class EcTpu:
             arr = jax.device_put(arr, jax.devices(self.platform)[0])
         return arr
 
-    def _apply(self, bitmat, x: np.ndarray) -> np.ndarray:
+    def _apply(self, bitmat, x: np.ndarray, kernel: str) -> np.ndarray:
+        with telemetry.dispatch(
+            kernel, telemetry.resolved_platform(self.platform),
+            x.shape[0], x.nbytes,
+        ):
+            return self._apply_inner(bitmat, x)
+
+    def _apply_inner(self, bitmat, x: np.ndarray) -> np.ndarray:
         n = self._mesh_width()
         # auto-detected meshes only engage once every device gets >=2
         # blocks; an explicitly pinned width engages as soon as padding
@@ -337,7 +343,7 @@ class EcTpu:
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(B, k, S) data shards -> (B, m, S) parity shards."""
         assert data.ndim == 3 and data.shape[1] == self.k and data.dtype == np.uint8
-        return self._apply(self._enc_bitmat, data)
+        return self._apply(self._enc_bitmat, data, "ec_encode")
 
     def reconstruct(
         self, shards: np.ndarray, present: list[int], want: list[int]
@@ -347,11 +353,12 @@ class EcTpu:
         erasure pattern (the pattern only changes the small traced matrix)."""
         key = (tuple(present[: self.k]), tuple(want))
         bitmat = self._recon_cache.get(key)
+        record_cache_event("ec_recon_matrix", bitmat is not None)
         if bitmat is None:
             rmat = gf.reconstruction_matrix(self.k, self.m, list(key[0]), list(want))
             bitmat = self._to_dev(gf.bitmatrix_of(rmat))
             self._recon_cache[key] = bitmat
-        return self._apply(bitmat, shards[:, : self.k, :])
+        return self._apply(bitmat, shards[:, : self.k, :], "ec_reconstruct")
 
     def encode_jit(self):
         """(bitmat, fn) for building fused pipelines (bench / graft entry)."""

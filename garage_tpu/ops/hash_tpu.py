@@ -20,10 +20,10 @@ host per block.
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 
+from ..utils.compile_cache import instrumented_cache
+from . import telemetry
 from .blake3_ref import CHUNK_END, CHUNK_START, IV, MSG_PERMUTATION, PARENT, ROOT
 
 BLOCK_LEN = 64
@@ -182,7 +182,7 @@ def _build(n_chunks: int):
     return jax.jit(hash_batch)
 
 
-@functools.lru_cache(maxsize=None)
+@instrumented_cache("blake3_hasher")
 def _hasher_for_len(length: int):
     if length % BLOCK_LEN != 0 or length == 0:
         raise ValueError("batched blake3 requires a positive multiple of 64 bytes")
@@ -200,7 +200,10 @@ def _hasher_for_len(length: int):
 def blake3_batch(x: np.ndarray) -> np.ndarray:
     """x: (B, L) uint8 -> (B, 32) uint8 official BLAKE3 digests."""
     fn = _hasher_for_len(x.shape[1])
-    return np.asarray(fn(x))
+    with telemetry.dispatch(
+        "blake3_hash", telemetry.resolved_platform(), x.shape[0], x.nbytes
+    ):
+        return np.asarray(fn(x))
 
 
 def blake3_batch_fn(length: int):

@@ -1,0 +1,74 @@
+"""TPU offload telemetry: every device dispatch leaves a metrics trail.
+
+The driver-gating throughput metric failed silently for five rounds
+partly because the offload path exported nothing — no dispatch counts,
+no batch sizes, no platform — so a wedge or a silent CPU fallback looked
+identical to healthy traffic until a human read a JSON artifact.  This
+module is the shared recorder the EC codec (ops/ec_tpu.py), the batched
+hasher (ops/hash_tpu.py) and the block codec layer (block/codec/) call
+around each device dispatch.  Families (rendered by the admin /metrics
+endpoint via utils/metrics.py; catalogued in doc/monitoring.md):
+
+  tpu_codec_dispatch_total{kernel,platform}      dispatches
+  tpu_codec_bytes_total{kernel,platform}         payload bytes processed
+  tpu_codec_batch_size{kernel}                   blocks/dispatch histogram
+  tpu_codec_dispatch_duration{kernel,platform}   seconds histogram
+  jax_backend_platform{platform}                 1 for each backend that
+                                                 has actually served a
+                                                 dispatch (scrape-time) —
+                                                 a bench believing it ran
+                                                 on TPU while the gauge
+                                                 says {platform="cpu"} is
+                                                 the five-round bug class
+                                                 this plane exists for
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from ..utils.metrics import SIZE_BUCKETS, registry
+
+registry.set_buckets("tpu_codec_batch_size", SIZE_BUCKETS)
+
+_platforms_seen: set[str] = set()
+
+
+def resolved_platform(pin: str | None = None) -> str:
+    """The platform label for a dispatch: the pinned platform if the
+    caller has one, else jax's resolved default backend, else "unknown"
+    (telemetry must never fail the math it observes)."""
+    if pin:
+        return pin
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def note_platform(platform: str) -> None:
+    """Register the scrape-time backend gauge once per resolved platform
+    (labels are fixed at registration, so the platform must already be
+    resolved — which it is by the time any dispatch runs)."""
+    if platform in _platforms_seen:
+        return
+    _platforms_seen.add(platform)
+    registry.register_gauge(
+        "jax_backend_platform", (("platform", platform),), lambda: 1.0
+    )
+
+
+@contextmanager
+def dispatch(kernel: str, platform: str, batch: int, nbytes: int):
+    """Instrument one device dispatch: counters + batch-size histogram on
+    entry, duration histogram (and `_errors` counter, via the registry
+    timer) around the body."""
+    lbl = (("kernel", kernel), ("platform", platform))
+    registry.incr("tpu_codec_dispatch_total", lbl)
+    registry.incr("tpu_codec_bytes_total", lbl, nbytes)
+    registry.observe("tpu_codec_batch_size", (("kernel", kernel),), float(batch))
+    note_platform(platform)
+    with registry.timer("tpu_codec_dispatch_duration", lbl):
+        yield

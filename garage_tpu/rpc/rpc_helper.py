@@ -30,6 +30,7 @@ from ..utils.backoff import Backoff
 from ..utils.background import spawn
 from ..utils.error import Quorum
 from ..utils.metrics import registry
+from ..utils.tracing import NOOP_SPAN, tracer
 from .peer_health import PeerHealth, PeerUnavailable
 
 logger = logging.getLogger("garage.rpc")
@@ -143,11 +144,25 @@ class RpcHelper:
             if attempt:
                 registry.incr("rpc_retry_counter", lbl)
                 await asyncio.sleep(backoff.next())
-            try:
-                return await self._call_once(
-                    endpoint, node, msg, prio, timeout, stream_factory,
-                    order_tag,
+            # each attempt is its own child span (the retry story of a
+            # request is visible in the trace: attempt number + what the
+            # breaker thought of the peer when the attempt launched)
+            cm = (
+                tracer.span(
+                    "rpc-attempt:" + endpoint.path,
+                    attempt=attempt,
+                    breaker=self.health.state_of(node),
+                    to=node.hex()[:16],
                 )
+                if tracer.enabled
+                else NOOP_SPAN
+            )
+            try:
+                with cm:
+                    return await self._call_once(
+                        endpoint, node, msg, prio, timeout, stream_factory,
+                        order_tag,
+                    )
             except PeerUnavailable as e:
                 # fast-fail is cheap; retrying it is pointless until the
                 # breaker half-opens, which takes longer than our backoff

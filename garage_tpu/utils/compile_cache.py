@@ -28,12 +28,51 @@ don't have a compile step).  This is TPU-operational plumbing.
 
 from __future__ import annotations
 
+import functools
 import os
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".xla_cache")
 
 _enabled = False
+
+
+def record_cache_event(cache: str, hit: bool) -> None:
+    """Count a compile-cache lookup in the metrics registry
+    (`tpu_compile_cache_{hit,miss}_total{cache=...}`) — the observability
+    answer to five rounds of silent wedges: a miss storm on the bench
+    path is visible on /metrics instead of buried in a JSON artifact."""
+    from .metrics import registry
+
+    registry.incr(
+        "tpu_compile_cache_hit_total" if hit else "tpu_compile_cache_miss_total",
+        (("cache", cache),),
+    )
+
+
+def instrumented_cache(cache_name: str):
+    """lru_cache-style memoizer that counts hits/misses per family.
+
+    Used for the in-process jit/trace caches (ec kernels, blake3
+    hashers): a process that keeps missing these is recompiling — exactly
+    the wedge mode the persistent cache exists to kill, now measurable."""
+
+    def deco(fn):
+        memo: dict = {}
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            key = (args, tuple(sorted(kwargs.items())))
+            hit = key in memo
+            record_cache_event(cache_name, hit)
+            if not hit:
+                memo[key] = fn(*args, **kwargs)
+            return memo[key]
+
+        wrapper.cache_clear = memo.clear  # type: ignore[attr-defined]
+        return wrapper
+
+    return deco
 
 
 def enable_persistent_cache(path: str | None = None) -> str:
@@ -59,6 +98,14 @@ def enable_persistent_cache(path: str | None = None) -> str:
     os.makedirs(path, exist_ok=True)
 
     jax.config.update("jax_compilation_cache_dir", path)
+    # scrape-time view of the persistent cache: entry count says whether
+    # a window has ever banked compiled executables for this backend
+    from .metrics import registry
+
+    registry.register_gauge(
+        "xla_persistent_cache_entries", (),
+        lambda: sum(1 for f in os.listdir(path) if not f.startswith(".")),
+    )
     # Cache EVERYTHING: the default thresholds skip small/fast compiles,
     # but on the tunneled backend even "fast" remote compiles can wedge —
     # a cache hit skips the remote round-trip entirely.

@@ -121,6 +121,9 @@ class Config:
     disable_scrub: bool = False
     use_local_tz: bool = False  # lifecycle worker day boundaries
     allow_punycode: bool = False  # xn-- bucket names/aliases
+    # "text" | "json" — JSON-lines output with trace_id/span_id stamping
+    # (utils/log_fmt.py); env GARAGE_LOG_FORMAT overrides
+    log_format: str = "text"
 
     block_size: int = DEFAULT_BLOCK_SIZE
     block_ram_buffer_max: int = 256 * 1024 * 1024
@@ -323,7 +326,7 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             "rpc_timeout_msec rpc_ping_timeout_msec "
             "bootstrap_peers allow_world_readable_secrets "
             "metadata_auto_snapshot_interval metadata_snapshots_dir "
-            "disable_scrub use_local_tz allow_punycode"
+            "disable_scrub use_local_tz allow_punycode log_format"
         ).split()
     }
     for k, v in raw.items():

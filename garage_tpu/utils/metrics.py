@@ -10,6 +10,11 @@ admin API, no external deps:
                               0.25 ms to ~8 s plus +Inf, so p99 is visible
                               (BASELINE's S3 target is a p99), rendered in
                               standard `_bucket{le=…}` form
+  - value histograms          set_buckets(name, SIZE_BUCKETS) declares a
+                              family whose observations are plain values
+                              (batch sizes, byte counts), bucketed on its
+                              own scheme and rendered with a `_sum` line
+                              instead of `_seconds_total`
   - gauges                    set_gauge() for pushed values, or
                               register_gauge(name, labels, fn) for values
                               polled at scrape time (queue lengths,
@@ -26,26 +31,46 @@ from contextlib import contextmanager as _contextmanager
 # 0.25 ms .. 8192 ms, log2-spaced (16 finite buckets)
 BUCKETS = [0.00025 * (2 ** i) for i in range(16)]
 
+# power-of-two count buckets (1 .. 65536): batch sizes, queue depths —
+# matches the log2 batching the TPU dispatch layer actually does
+SIZE_BUCKETS = [float(2 ** i) for i in range(17)]
+
 
 class Metrics:
     def __init__(self) -> None:
         self.counters: dict[tuple, float] = defaultdict(float)
-        # (name, labels) -> [count, sum_seconds, bucket_counts]
-        self.durations: dict[tuple, list] = defaultdict(
-            lambda: [0, 0.0, [0] * (len(BUCKETS) + 1)]
-        )
+        # (name, labels) -> [count, sum, bucket_counts]
+        self.durations: dict[tuple, list] = {}
         self.gauges: dict[tuple, float] = {}
         self._gauge_fns: dict[tuple, object] = {}
+        # family name -> custom bucket bounds (absent = BUCKETS, seconds)
+        self._family_buckets: dict[str, list[float]] = {}
 
     def incr(self, name: str, labels: tuple = (), by: float = 1) -> None:
         self.counters[(name, labels)] += by
 
-    def observe(self, name: str, labels: tuple, seconds: float) -> None:
-        d = self.durations[(name, labels)]
+    def set_buckets(self, name: str, buckets: list[float]) -> None:
+        """Declare a value-histogram family with its own bucket bounds
+        (e.g. SIZE_BUCKETS).  Idempotent; must precede the first observe
+        — existing samples were bucketed under the old bounds, so a late
+        re-declaration would silently corrupt the family."""
+        if name in self._family_buckets:
+            return
+        if any(k[0] == name for k in self.durations):
+            raise ValueError(
+                f"set_buckets({name!r}) after the family has samples"
+            )
+        self._family_buckets[name] = buckets
+
+    def observe(self, name: str, labels: tuple, value: float) -> None:
+        bs = self._family_buckets.get(name, BUCKETS)
+        d = self.durations.get((name, labels))
+        if d is None:
+            d = self.durations[(name, labels)] = [0, 0.0, [0] * (len(bs) + 1)]
         d[0] += 1
-        d[1] += seconds
-        for i, ub in enumerate(BUCKETS):
-            if seconds <= ub:
+        d[1] += value
+        for i, ub in enumerate(bs):
+            if value <= ub:
                 d[2][i] += 1
                 return
         d[2][-1] += 1
@@ -69,12 +94,13 @@ class Metrics:
         d = self.durations.get((name, labels))
         if d is None or d[0] == 0:
             return None
+        bs = self._family_buckets.get(name, BUCKETS)
         target = q * d[0]
         acc = 0
         for i, c in enumerate(d[2]):
             acc += c
             if acc >= target:
-                return BUCKETS[i] if i < len(BUCKETS) else float("inf")
+                return bs[i] if i < len(bs) else float("inf")
         return float("inf")
 
     def render(self) -> list[str]:
@@ -82,14 +108,19 @@ class Metrics:
         for (name, labels), v in sorted(self.counters.items()):
             lines.append(f"{name}{_fmt(labels)} {v:g}")
         for (name, labels), (n, total, buckets) in sorted(self.durations.items()):
+            bs = self._family_buckets.get(name, BUCKETS)
             acc = 0
             for i, c in enumerate(buckets[:-1]):
                 acc += c
-                le = (("le", f"{BUCKETS[i]:g}"),)
+                le = (("le", f"{bs[i]:g}"),)
                 lines.append(f"{name}_bucket{_fmt(labels + le)} {acc}")
             lines.append(f'{name}_bucket{_fmt(labels + (("le", "+Inf"),))} {n}')
             lines.append(f"{name}_count{_fmt(labels)} {n}")
-            lines.append(f"{name}_seconds_total{_fmt(labels)} {total:.6f}")
+            if name in self._family_buckets:
+                # value histogram: the sum is in the family's own unit
+                lines.append(f"{name}_sum{_fmt(labels)} {total:g}")
+            else:
+                lines.append(f"{name}_seconds_total{_fmt(labels)} {total:.6f}")
         gauges = dict(self.gauges)
         for (name, labels), fn in self._gauge_fns.items():
             try:

@@ -9,6 +9,18 @@ POSTs them as OTLP/HTTP JSON (`/v1/traces`) to the sink.  When no sink is
 configured the API is a near-zero-cost no-op — the hot paths stay hot.
 
 Span ids follow W3C sizes: 16-byte trace id, 8-byte span id.
+
+Cross-node propagation (Dapper-style): `tracer.inject()` serializes the
+current span as a compact binary traceparent — 16-byte trace id + 8-byte
+parent span id + 1 flag byte (0x01 = sampled), the W3C traceparent
+fields without the hex framing — which the RPC layer carries inside the
+request frame (`net/connection.py` meta key "tp").  The receiving node
+calls `tracer.extract()` and opens its handler span with
+`remote_parent=...`, so one S3 PUT against a multi-node cluster yields
+ONE trace whose `rpc-handle:*` spans on remote nodes share the root
+trace id.  Hot paths guard with `if tracer.enabled` and fall back to the
+shared `NOOP_SPAN`, so a disabled tracer allocates no Span objects, no
+attr dicts, and no traceparent bytes.
 """
 
 from __future__ import annotations
@@ -29,6 +41,38 @@ _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 MAX_BUFFER = 8192
 FLUSH_INTERVAL = 3.0
 
+TRACEPARENT_LEN = 16 + 8 + 1  # trace id + parent span id + flags
+FLAG_SAMPLED = 0x01
+
+
+class _NoopSpan:
+    """Reusable, re-enterable no-op context manager: the disabled-tracing
+    fast path.  Hot callers use `tracer.span(...) if tracer.enabled else
+    NOOP_SPAN` so the disabled branch never builds span names or attrs."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class RemoteParent:
+    """A parent span living on another node, reconstructed from a
+    traceparent.  Duck-typed to Span for the two fields a child reads."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: bytes, span_id: bytes, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
 
 class Span:
     __slots__ = (
@@ -36,7 +80,7 @@ class Span:
         "start_ns", "end_ns", "attrs", "ok",
     )
 
-    def __init__(self, name: str, parent: "Span | None", attrs: dict):
+    def __init__(self, name: str, parent: "Span | RemoteParent | None", attrs: dict):
         self.name = name
         self.trace_id = parent.trace_id if parent else os.urandom(16)
         self.span_id = os.urandom(8)
@@ -86,13 +130,21 @@ class Tracer:
             self._session = None
 
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, remote_parent: RemoteParent | None = None, **attrs):
         """Context manager for a traced operation.  Cheap no-op (no span
-        object at all) when tracing is off."""
+        object at all) when tracing is off.
+
+        `remote_parent` (from `extract()`) parents the span across the
+        wire.  When given it WINS over any context-inherited span: a
+        handler task inherits the contextvars snapshot of the connection's
+        recv loop (frozen at connection setup), so an in-context span
+        there is stale; the traceparent the caller serialized is the
+        truth.  On the local-dispatch shortcut both agree — the injected
+        traceparent is the caller's current span."""
         if not self.enabled:
             yield None
             return
-        parent = _current.get()
+        parent = remote_parent or _current.get()
         s = Span(name, parent, attrs)
         token = _current.set(s)
         try:
@@ -109,6 +161,29 @@ class Tracer:
     def current(self) -> Span | None:
         return _current.get()
 
+    # --- cross-node propagation -----------------------------------------------
+
+    def inject(self) -> bytes | None:
+        """Serialize the current span for the wire: 16-byte trace id +
+        8-byte span id + flags (W3C traceparent fields, binary).  None
+        when tracing is off or no span is active — callers then omit the
+        frame field entirely, keeping the disabled wire format identical."""
+        if not self.enabled:
+            return None
+        s = _current.get()
+        if s is None:
+            return None
+        return s.trace_id + s.span_id + bytes((FLAG_SAMPLED,))
+
+    def extract(self, tp: bytes | None) -> RemoteParent | None:
+        """Parse a traceparent produced by `inject()` on another node.
+        Malformed or absent input yields None (the span becomes a local
+        root — never an error: tracing must not fail requests)."""
+        if not isinstance(tp, (bytes, bytearray)) or len(tp) != TRACEPARENT_LEN:
+            return None
+        tp = bytes(tp)
+        return RemoteParent(tp[:16], tp[16:24], bool(tp[24] & FLAG_SAMPLED))
+
     # --- export ---------------------------------------------------------------
 
     async def _flusher(self) -> None:
@@ -123,17 +198,21 @@ class Tracer:
         if not self._buf or not self.sink:
             return
         spans, self._buf = self._buf, []
-        payload = self._otlp(spans)
         import aiohttp
 
         if self._session is None or self._session.closed:
             self._session = aiohttp.ClientSession()
         url = self.sink.rstrip("/") + "/v1/traces"
-        async with self._session.post(
-            url, json=payload, timeout=aiohttp.ClientTimeout(total=10)
-        ) as resp:
-            if resp.status >= 400:
-                logger.debug("trace sink returned %d", resp.status)
+        # chunked export: one giant POST can exceed a collector's request
+        # size limit (aiohttp servers default to 1 MiB) and lose the whole
+        # batch; ~500 spans stays comfortably under typical limits
+        for i in range(0, len(spans), 500):
+            payload = self._otlp(spans[i : i + 500])
+            async with self._session.post(
+                url, json=payload, timeout=aiohttp.ClientTimeout(total=10)
+            ) as resp:
+                if resp.status >= 400:
+                    logger.debug("trace sink returned %d", resp.status)
 
     def _otlp(self, spans: list[Span]) -> dict:
         """OTLP/HTTP JSON encoding (trace ids hex, times in ns strings)."""

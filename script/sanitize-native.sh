@@ -1,13 +1,71 @@
 #!/bin/sh
-# Sanitizer job for the native C++ hot paths (ASan + UBSan), the rebuild's
-# answer to SURVEY §5's race-detection/sanitizer gap: build an
-# instrumented libgarage_native and run the full oracle cross-check suite
-# against it.  Any overflow, OOB access, or UB in gf8.cpp / blake3.cpp
-# fails the run.
+# Sanitizer job for the native C++ hot paths, the rebuild's answer to
+# SURVEY §5's race-detection/sanitizer gap.
 #
-#   ./script/sanitize-native.sh
+#   ./script/sanitize-native.sh          ASan + UBSan: build an
+#       instrumented libgarage_native and run the full oracle cross-check
+#       suite against it.  Any overflow, OOB access, or UB in gf8.cpp /
+#       blake3.cpp / kvlog.cpp fails the run.
+#
+#   ./script/sanitize-native.sh --tsan   ThreadSanitizer: rebuild with
+#       -fsanitize=thread and hammer the kvlog group-commit machinery —
+#       the flusher thread racing committers, barriers and compactions is
+#       the only cross-thread surface in the native code (everything else
+#       is called from the single asyncio thread).  Data races on the
+#       fd/seq counters fail the run.
 set -e
 cd "$(dirname "$0")/.."
+
+if [ "$1" = "--tsan" ]; then
+    TSAN_SO=/tmp/libgarage_native_tsan.so
+    g++ -g -O1 -pthread -fsanitize=thread -fno-omit-frame-pointer \
+        -shared -fPIC -std=c++17 -o "$TSAN_SO" \
+        garage_tpu/_native/gf8.cpp garage_tpu/_native/blake3.cpp \
+        garage_tpu/_native/kvlog.cpp
+
+    LIBTSAN=$(g++ -print-file-name=libtsan.so)
+    export GARAGE_NATIVE_SO="$TSAN_SO"
+    export LD_PRELOAD="$LIBTSAN"
+    # the interpreter is not TSan-built: only our instrumented .so (plus
+    # intercepted pthread/malloc) is tracked, which is exactly the
+    # flusher-vs-committer surface this mode exists to check
+    export TSAN_OPTIONS="halt_on_error=1 exitcode=66 report_thread_leaks=0"
+    export JAX_PLATFORMS=cpu
+    unset PALLAS_AXON_POOL_IPS
+
+    python - <<'EOF'
+import os, tempfile
+
+from garage_tpu import _native
+from garage_tpu.db.native_engine import NativeDb, _CtypesBinding
+
+assert _native.available(), "tsan library failed to load"
+binding = _CtypesBinding(_native.lib())
+tmp = tempfile.mkdtemp()
+
+# group-commit mode: the dedicated flusher thread syncs continuously
+# while this thread commits, forces compactions (fd swaps under mu), and
+# waits barriers — the full cross-thread protocol, under TSan
+path = os.path.join(tmp, "tsan-group.log")
+db = NativeDb(path, fsync="group", binding=binding)
+t = db.open_tree("g")
+for i in range(20000):
+    t.insert(b"gk%05d" % (i % 1024), os.urandom(64))
+    if i % 500 == 499:
+        db.sync_barrier()
+    if i % 2000 == 1999:
+        db.kv.compact(db.h)
+db.sync_barrier()
+assert db.kv.sync_failures(db.h) == 0
+assert len(t) == 1024
+db.close()
+db2 = NativeDb(path, fsync="group", binding=binding)
+assert len(db2.open_tree("g")) == 1024
+db2.close()
+print("tsan: group-commit flusher/committer stress clean (no data races)")
+EOF
+    exit 0
+fi
 
 SAN_SO=/tmp/libgarage_native_san.so
 # -march=native so the SIMD (pshufb) paths are the ones instrumented

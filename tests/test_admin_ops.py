@@ -445,7 +445,11 @@ def test_admin_api_connect_health_nodeinfo(tmp_path):
                     assert r.status == 200
                     h = await r.json()
                     assert h["status"] in ("healthy", "degraded", "unavailable")
-                    assert "partitions_quorum" in h
+                    # camelCase like the reference ClusterHealth resource
+                    # (round-4 fix: this used to leak snake_case)
+                    assert "partitionsQuorum" in h
+                    assert "storageNodesOk" in h
+                    assert "partitions_quorum" not in h
 
                 async with sess.get(base + "/v1/node") as r:
                     assert r.status == 200

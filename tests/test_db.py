@@ -505,4 +505,13 @@ def test_native_group_commit_sigkill_durability(tmp_path):
     # bounded loss: the flusher syncs continuously (~200us/fdatasync);
     # even pessimistically the window is far below 2000 acked commits
     assert n >= acked - 2000, (n, acked)
+    # regression note (advisor round 4, fixed with the observability PR):
+    # flusher_main now checks the ::fdatasync(sfd) return value — on
+    # failure seq_durable does NOT advance (kv_sync_barrier can no longer
+    # report unsynced commits as durable; it fails fast on a sick
+    # flusher), and a dup/fdatasync failure paces a bounded retry instead
+    # of busy-spinning.  kv_sync_failures(h) counts those failures: on a
+    # healthy disk it must be 0 after a full barrier round-trip.
+    db.sync_barrier()
+    assert db.kv.sync_failures(db.h) == 0
     db.close()
