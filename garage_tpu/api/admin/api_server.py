@@ -133,7 +133,15 @@ class AdminApiServer:
 
     def _metrics(self) -> web.Response:
         """Prometheus exposition (metric families per layer, reference
-        doc/book/reference-manual/monitoring.md)."""
+        doc/book/reference-manual/monitoring.md).
+
+        Only families the registry does NOT own are rendered inline; the
+        resync/merkle/gc queue lengths and `cluster_connected_nodes` come
+        exclusively from the registry gauges (model/garage.py), and
+        per-worker health from the runner's `worker_*` families
+        (utils/background.py) — emitting them here too was a strict
+        exposition-format violation (duplicate families), caught by the
+        metrics-lint test."""
         g = self.garage
         h = g.system.health()
         lines = []
@@ -141,33 +149,21 @@ class AdminApiServer:
         def m(name, value, help_=""):
             if help_:
                 lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {value}")
 
         m("cluster_healthy", 1 if h.status == "healthy" else 0, "cluster health")
         m("cluster_known_nodes", h.known_nodes)
-        m("cluster_connected_nodes", h.connected_nodes)
         m("cluster_storage_nodes", h.storage_nodes)
         m("cluster_storage_nodes_up", h.storage_nodes_up)
         m("cluster_partitions_quorum", h.partitions_quorum)
         m("cluster_partitions_all_ok", h.partitions_all_ok)
         m("cluster_layout_version", g.layout_manager.history.current().version)
+        lines.append("# TYPE table_size gauge")
         for t in g.tables:
             n = t.schema.table_name
             lines.append(f'table_size{{table_name="{n}"}} {len(t.data.store)}')
-            lines.append(
-                f'table_merkle_updater_todo_queue_length{{table_name="{n}"}} '
-                f"{len(t.data.merkle_todo)}"
-            )
-            lines.append(f'table_gc_todo_queue_length{{table_name="{n}"}} {len(t.data.gc_todo)}')
-        bm = g.block_manager
-        m("block_resync_queue_length", bm.resync.queue_len(), "blocks awaiting resync")
-        m("block_resync_errored_blocks", bm.resync.errors_len())
-        m("block_rc_entries", len(bm.rc.tree))
-        for wid, info in g.bg.worker_info().items():
-            lines.append(
-                f'worker_errors{{worker="{info.name}"}} {info.errors}'
-            )
+        m("block_rc_entries", len(g.block_manager.rc.tree))
         from ...utils.metrics import registry
 
         lines.extend(registry.render())
@@ -234,6 +230,31 @@ class AdminApiServer:
                     "partitionsQuorum": h.partitions_quorum,
                     "partitionsAllOk": h.partitions_all_ok,
                 }
+            )
+
+        if path == "/v1/debug/profile" and request.method == "GET":
+            # flight recorder: on-demand sampling profiler (utils/flight.py).
+            # Folded-stack text by default; ?format=speedscope for JSON.
+            from ...utils import flight
+
+            prof = await flight.profile(
+                request.query.get("seconds", "2"),
+                hz=request.query.get("hz", "100"),
+            )
+            if request.query.get("format") == "speedscope":
+                return web.json_response(prof.speedscope())
+            return web.Response(
+                text=prof.folded(),
+                content_type="text/plain",
+                headers={"x-garage-profile-samples": str(prof.samples)},
+            )
+
+        if path == "/v1/debug/slow" and request.method == "GET":
+            # flight recorder: span trees of the slowest recent requests
+            from ...utils import flight
+
+            return web.json_response(
+                flight.slow_response(getattr(g, "flight_recorder", None))
             )
 
         if path == "/v1/connect" and request.method == "POST":

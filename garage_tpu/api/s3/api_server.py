@@ -50,7 +50,18 @@ class S3ApiServer:
         self.root_domain = garage.config.s3_api.root_domain
         self.app = web.Application(client_max_size=64 * 1024 * 1024 * 1024)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
+        # streamed responses (multi-block GETs) prepare inside the
+        # handler, before _entry can stamp headers — this signal fires
+        # at prepare time, while the request span is still open
+        self.app.on_response_prepare.append(self._stamp_request_id)
         self.runner: web.AppRunner | None = None
+
+    async def _stamp_request_id(self, request, response) -> None:
+        from ...utils.tracing import tracer
+
+        s = tracer.current()
+        if s is not None and "x-amz-request-id" not in response.headers:
+            response.headers["x-amz-request-id"] = s.trace_id.hex()
 
     async def start(self, host: str, port: int) -> None:
         self.runner = web.AppRunner(self.app, access_log=None)
@@ -90,41 +101,56 @@ class S3ApiServer:
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
         from ...utils.metrics import request_metrics
+        from ...utils.tracing import tracer
+
+        # correlate client-observed latency (and failures) with the
+        # node's slow-request flight recorder (/v1/debug/slow) and
+        # exported traces: the request id IS the trace id.  Captured
+        # inside the request span so error responses carry it too —
+        # the failed slow PUT is exactly the one worth joining.
+        trace_hex: str | None = None
+
+        def rid(resp: web.StreamResponse) -> web.StreamResponse:
+            if trace_hex and not resp.prepared:
+                resp.headers["x-amz-request-id"] = trace_hex
+            return resp
 
         try:
             with request_metrics(
                 "api_s3", request.method, "api:s3", path=request.path
             ):
-                return await self._handle(request)
+                s = tracer.current()
+                trace_hex = s.trace_id.hex() if s is not None else None
+                return rid(await self._handle(request))
         except ApiError as e:
             if e.status == 304:
-                return web.Response(status=304)
-            return web.Response(
+                return rid(web.Response(status=304))
+            return rid(web.Response(
                 status=e.status,
                 text=error_xml(e, request.path),
                 content_type="application/xml",
-            )
+            ))
         except Error as e:
             msg = str(e)
             if "not found" in msg:
-                return web.Response(
+                return rid(web.Response(
                     status=404,
                     text=error_xml(NoSuchBucket(msg), request.path),
                     content_type="application/xml",
-                )
+                ))
             logger.exception("internal error")
-            return web.Response(
+            return rid(web.Response(
                 status=500,
                 text=error_xml(ApiError(msg), request.path),
                 content_type="application/xml",
-            )
+            ))
         except Exception as e:  # noqa: BLE001
             logger.exception("unhandled API error")
-            return web.Response(
+            return rid(web.Response(
                 status=500,
                 text=error_xml(ApiError(repr(e)), request.path),
                 content_type="application/xml",
-            )
+            ))
 
     async def _handle(self, request: web.Request) -> web.StreamResponse:
         # PostObject: browser form uploads authenticate via a signed policy

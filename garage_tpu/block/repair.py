@@ -118,6 +118,9 @@ class ScrubWorker(Worker):
             "paused": self.paused,
         }
 
+    def tranquility(self) -> int | None:
+        return self.state.tranquility
+
     # --- operator controls (reference `garage repair scrub {…}`) -------------
 
     def cmd_start(self) -> None:

@@ -340,6 +340,15 @@ class _ResyncWorker(Worker):
             "errors": self.resync.errors_len(),
         }
 
+    def tranquility(self) -> int | None:
+        return self.resync.tranquility
+
+    def queue_length(self) -> int | None:
+        # the resync queue is shared by all resync workers: only index 0
+        # exports it, or aggregations over the family would overcount
+        # the backlog n_workers times
+        return self.resync.queue_len() if self.index == 0 else None
+
     async def work(self):
         if self.index >= self.resync.n_workers:
             return (WorkerState.THROTTLED, 10.0)  # worker disabled by config

@@ -478,6 +478,14 @@ class AdminRpcHandler:
                 "name": info.name,
                 "state": info.state,
                 "errors": info.errors,
+                "consecutive_errors": info.consecutive_errors,
+                "last_error": info.last_error,
+                "tranquility": info.tranquility,
+                "iterations": info.iterations,
+                "last_duration_secs": info.last_duration_secs,
+                "duration_ewma_secs": info.duration_ewma_secs,
+                "throughput": info.throughput,
+                "last_completed": info.last_completed,
                 "info": info.progress,
             }
             for wid, info in self.garage.bg.worker_info().items()
@@ -535,6 +543,26 @@ class AdminRpcHandler:
         else:
             raise ValueError(f"unknown repair target {what!r}")
         return f"repair {what} launched"
+
+    # --- flight recorder (debug profile/slow, utils/flight.py) ----------------
+
+    async def op_debug_profile(self, args) -> Any:
+        from ..utils import flight
+
+        prof = await flight.profile(
+            args.get("seconds") or 2.0, hz=args.get("hz") or 100
+        )
+        out: dict[str, Any] = {"samples": prof.samples}
+        if args.get("format") == "speedscope":
+            out["speedscope"] = prof.speedscope()
+        else:
+            out["folded"] = prof.folded()
+        return out
+
+    async def op_debug_slow(self, args) -> Any:
+        from ..utils import flight
+
+        return flight.slow_response(getattr(self.garage, "flight_recorder", None))
 
     async def op_meta_snapshot(self, args) -> Any:
         from ..model.snapshot import take_snapshot

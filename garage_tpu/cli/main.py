@@ -147,6 +147,20 @@ def main(argv=None):
     wrk.add_argument("worker_cmd", choices=["list", "get", "set"])
     wrk.add_argument("var", nargs="?")
     wrk.add_argument("value", nargs="?")
+
+    dbg = sub.add_parser("debug", help="flight recorder: node self-diagnostics")
+    dbg_sub = dbg.add_subparsers(dest="debug_cmd", required=True)
+    dpr = dbg_sub.add_parser(
+        "profile", help="sample the daemon's stacks (folded/speedscope)"
+    )
+    dpr.add_argument("--seconds", type=float, default=2.0)
+    dpr.add_argument("--hz", type=int, default=100)
+    dpr.add_argument(
+        "--speedscope", action="store_true",
+        help="emit speedscope JSON instead of folded stacks",
+    )
+    dpr.add_argument("-o", "--output", help="write to a file instead of stdout")
+    dbg_sub.add_parser("slow", help="slowest recent requests (span trees)")
     rep = sub.add_parser("repair")
     rep.add_argument(
         "what",
@@ -591,13 +605,65 @@ async def dispatch(args, call, config) -> str | None:
             await call("worker-set", {"var": args.var, "value": args.value})
         )
     if args.cmd == "worker":
+        import time as _time
+
         ws = await call("worker-list")
-        rows = ["id\tname\tstate\terrors\tinfo"]
+        if jd:
+            return jd(ws)
+        rows = ["id\tname\tstate\terrors\ttranq\trate\tlast\tinfo"]
+        now = _time.time()
         for w in ws:
+            tq = w.get("tranquility")
+            rate = w.get("throughput")
+            done = w.get("last_completed")
             rows.append(
-                f"{w['id']}\t{w['name']}\t{w['state']}\t{w['errors']}\t{w['info']}"
+                f"{w['id']}\t{w['name']}\t{w['state']}\t{w['errors']}\t"
+                f"{'-' if tq is None else tq}\t"
+                f"{'-' if rate is None else f'{rate:.2f}/s'}\t"
+                f"{'-' if done is None else f'{max(0, now - done):.0f}s ago'}\t"
+                f"{w['info']}"
             )
         return format_table(rows)
+
+    if args.cmd == "debug":
+        if args.debug_cmd == "profile":
+            a = {"seconds": args.seconds, "hz": args.hz}
+            if args.speedscope:
+                a["format"] = "speedscope"
+            r = await call("debug-profile", a)
+            body = (
+                json.dumps(r["speedscope"]) if args.speedscope else r["folded"]
+            )
+            if args.output:
+                with open(args.output, "w") as f:
+                    f.write(body)
+                return (
+                    f"wrote {len(body)} bytes "
+                    f"({r['samples']} sampling rounds) to {args.output}"
+                )
+            return body
+        if args.debug_cmd == "slow":
+            r = await call("debug-slow")
+            if jd:
+                return jd(r)
+            if not r["enabled"]:
+                return (
+                    "flight recorder disabled "
+                    "([admin] flight_recorder = false)"
+                )
+            if not r["requests"]:
+                return (
+                    f"no requests above {r['thresholdMs']:g} ms recorded"
+                )
+            rows = ["trace\tname\tms\tspans\tok\tattrs"]
+            for q in r["requests"]:
+                attrs = ",".join(f"{k}={v}" for k, v in q["attrs"].items())
+                rows.append(
+                    f"{q['traceId'][:16]}\t{q['name']}\t"
+                    f"{q['durationMs']:.1f}\t{len(q['spans'])}\t"
+                    f"{'y' if q['ok'] else 'n'}\t{attrs}"
+                )
+            return format_table(rows)
 
     if args.cmd == "block":
         bc = args.block_cmd

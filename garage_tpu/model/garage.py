@@ -274,14 +274,42 @@ class Garage:
         self.bg_vars.register_rw(
             "resync-tranquility",
             lambda: str(resync.tranquility),
-            lambda v: setattr(resync, "tranquility", int(v)),
+            lambda v: setattr(resync, "tranquility", max(0, int(v))),
         )
         self.bg_vars.register_rw(
             "resync-worker-count",
             lambda: str(resync.n_workers),
             lambda v: setattr(resync, "n_workers", max(1, min(8, int(v)))),
         )
+
+        def _scrub_worker():
+            sw = getattr(self.block_manager, "scrub_worker", None)
+            if sw is None:
+                raise ValueError("scrub worker not running")
+            return sw
+
+        self.bg_vars.register_rw(
+            "scrub-tranquility",
+            lambda: str(_scrub_worker().state.tranquility),
+            lambda v: _scrub_worker().cmd_set_tranquility(int(v)),
+        )
+
+        def _set_sync_interval(v: str) -> None:
+            secs = float(v)
+            if secs <= 0:
+                raise ValueError("sync-interval-secs must be > 0")
+            for t in self.tables:
+                t.syncer.anti_entropy_interval = secs
+
+        self.bg_vars.register_rw(
+            "sync-interval-secs",
+            lambda: str(self.tables[0].syncer.anti_entropy_interval),
+            _set_sync_interval,
+        )
         self.bg = BackgroundRunner()
+        # flight recorder plane (utils/flight.py), wired in start()
+        self.flight_recorder = None
+        self.watchdog = None
         self._started = False
 
     def ec_layout_warning(self, lv) -> str | None:
@@ -318,6 +346,20 @@ class Garage:
         if self.config.admin.trace_sink:
             tracer.configure(self.config.admin.trace_sink)
             await tracer.start()
+        from ..utils import flight
+
+        adm = self.config.admin
+        if adm.flight_recorder:
+            self.flight_recorder = flight.SlowRequestRecorder(
+                threshold_ms=adm.slow_request_threshold_msec,
+                top_k=adm.slow_request_top_k,
+            )
+            tracer.add_hook(self.flight_recorder.on_span_end)
+        if adm.event_loop_watchdog_threshold_msec:
+            self.watchdog = flight.EventLoopWatchdog(
+                threshold=adm.event_loop_watchdog_threshold_msec / 1000.0
+            )
+            self.watchdog.start()
         self._register_gauges()
         self._started = True
 
@@ -368,6 +410,12 @@ class Garage:
     async def stop(self) -> None:
         from ..utils.tracing import tracer
 
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        if self.flight_recorder is not None:
+            tracer.remove_hook(self.flight_recorder.on_span_end)
+            self.flight_recorder = None
         await self.bg.shutdown()
         await self.system.stop()
         await self.netapp.shutdown()

@@ -38,6 +38,8 @@ class TableSyncer:
         )
         self.endpoint.set_handler(self._handle)
         self._layout_changed = asyncio.Event()
+        # runtime-tunable via `worker set sync-interval-secs` (BgVars)
+        self.anti_entropy_interval = ANTI_ENTROPY_INTERVAL
         table.system.layout_manager.subscribe(self._on_layout_change)
         table.system.layout_manager.register_sync_component(
             f"table:{table.schema.table_name}"
@@ -216,7 +218,7 @@ class _SyncWorker(Worker):
     async def work(self):
         now = time.monotonic()
         lm = self.syncer.table.system.layout_manager
-        due = now - self.last_sync >= ANTI_ENTROPY_INTERVAL
+        due = now - self.last_sync >= self.syncer.anti_entropy_interval
         # placement digest captured BEFORE the round: a version applied
         # mid-round changes the live digest, so the next wakeup re-rounds
         placement = lm.history.placement_digest()
@@ -254,7 +256,7 @@ class _SyncWorker(Worker):
             )
         else:
             self._retry_backoff = min(
-                self._retry_backoff * 2 or 10.0, ANTI_ENTROPY_INTERVAL
+                self._retry_backoff * 2 or 10.0, self.syncer.anti_entropy_interval
             )
         return WorkerState.IDLE
 

@@ -56,6 +56,15 @@ class AdminConfig:
     metrics_token: str | None = None
     metrics_token_file: str | None = None
     trace_sink: str | None = None
+    # flight recorder (utils/flight.py): slow-request ring buffer served
+    # from /v1/debug/slow — on by default so a node self-diagnoses with
+    # zero external collectors (enables span creation without a sink)
+    flight_recorder: bool = True
+    slow_request_threshold_msec: float = 500.0
+    slow_request_top_k: int = 64
+    # event-loop watchdog: scheduling-lag histogram + blocked-loop task
+    # dumps; 0 disables
+    event_loop_watchdog_threshold_msec: float = 250.0
 
 
 @dataclass

@@ -1,0 +1,521 @@
+"""Flight recorder: node-local self-diagnostics.
+
+Three coordinated tools that answer "why is this node slow?" from a
+RUNNING daemon, with zero external collectors attached (the
+`/debug/pprof` plane every production store grows; reference Garage
+leans on tokio-console + metrics for the same questions):
+
+  1. **Sampling profiler** — `profile(seconds, hz)` spawns a thread
+     that samples `sys._current_frames()` (every thread's live stack)
+     plus the asyncio task set at ~100 Hz, aggregates collapsed stacks,
+     and renders them as folded-stack text (flamegraph.pl / speedscope
+     paste format) or speedscope JSON.  Served from admin
+     `GET /v1/debug/profile?seconds=N` and `cli ... debug profile`.
+     Because the sampler is a *thread*, it keeps sampling even while
+     the event loop is wedged — the wedge IS the profile.
+
+  2. **Event-loop watchdog** — `EventLoopWatchdog` measures scheduling
+     lag continuously (a self-rescheduling `call_later` beat feeds the
+     `event_loop_lag_seconds` histogram) while a monitor thread detects
+     stalls *in progress*: when the beat goes unserviced past the
+     threshold it increments `event_loop_blocked_total`, samples the
+     loop thread's current stack (the culprit, caught red-handed), and
+     dumps every live asyncio task stack with its trace id (PR 2 log
+     correlation) to the log, rate-limited.
+
+  3. **Slow-request flight recorder** — `SlowRequestRecorder` hooks
+     `utils/tracing.py` span end and retains the span trees of the
+     slowest recent requests (threshold + top-K ring buffer), served
+     from `GET /v1/debug/slow` and `cli ... debug slow`.  Attaching the
+     hook enables span creation even without an OTLP sink, so "what was
+     that p99" is answerable post-hoc on any node.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import sys
+import threading
+import time
+
+from .metrics import BUCKETS, registry
+
+logger = logging.getLogger("garage.flight")
+
+# --- stack formatting helpers -------------------------------------------------
+
+
+def _format_frame(frame) -> str:
+    code = frame.f_code
+    path = code.co_filename.replace("\\", "/").split("/")
+    short = "/".join(path[-2:])
+    # ';' is the folded-stack separator — keep it out of frame names
+    name = code.co_name.replace(";", ",")
+    return f"{name} ({short}:{frame.f_lineno})"
+
+
+def _thread_stack(frame) -> list[str]:
+    """Leaf frame -> root-first formatted stack."""
+    out: list[str] = []
+    while frame is not None:
+        out.append(_format_frame(frame))
+        frame = frame.f_back
+    out.reverse()
+    return out
+
+
+def _task_frames(task) -> list:
+    """Outermost-first suspended frames of an asyncio task, walking the
+    cr_await chain.  Empty for a currently-RUNNING task (its frames show
+    up in `sys._current_frames()` instead)."""
+    frames = []
+    coro = task.get_coro()
+    seen = 0
+    while coro is not None and seen < 64:
+        seen += 1
+        fr = getattr(coro, "cr_frame", None) or getattr(coro, "gi_frame", None)
+        if fr is None:
+            break  # running (or closed): the thread sampler owns it
+        frames.append(fr)
+        coro = getattr(coro, "cr_await", None) or getattr(coro, "gi_yieldfrom", None)
+    return frames
+
+
+def _task_label(task) -> str:
+    coro = task.get_coro()
+    name = getattr(coro, "__qualname__", None) or task.get_name()
+    return f"task:{name}".replace(";", ",")
+
+
+def _all_tasks(loop) -> set:
+    """asyncio.all_tasks from another thread: the WeakSet can mutate
+    mid-iteration on a live loop; retry a few times, give up quietly
+    (a wedged loop — the interesting case — cannot mutate it)."""
+    for _ in range(4):
+        try:
+            return asyncio.all_tasks(loop)
+        except RuntimeError:
+            continue
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            break
+    return set()
+
+
+def _task_trace_id(task) -> str:
+    """Trace id of the span active in a task, '' when none.
+
+    `Task.get_context()` only exists on 3.12+ and the 3.10/3.11 C task
+    exposes no `_context` either, so fall back to scanning the await
+    chain's frame locals: every tracing call site binds its span
+    contextmanager to a local (`cm` in netapp/rpc_helper, `s` under
+    `with ... as s`), which makes the active span recoverable from a
+    suspended task on any supported interpreter."""
+    try:
+        from .tracing import Span, _current
+
+        getctx = getattr(task, "get_context", None)
+        ctx = getctx() if getctx is not None else getattr(task, "_context", None)
+        if ctx is not None:
+            span = ctx.get(_current)
+            if span is not None:
+                return span.trace_id.hex()
+        for fr in reversed(_task_frames(task)):  # innermost first
+            for v in fr.f_locals.values():
+                if isinstance(v, Span):
+                    return v.trace_id.hex()
+                # _GeneratorContextManager from tracer.span(): the Span
+                # lives in the suspended generator frame as `s`
+                gen_frame = getattr(getattr(v, "gen", None), "gi_frame", None)
+                if gen_frame is not None:
+                    s = gen_frame.f_locals.get("s")
+                    if isinstance(s, Span):
+                        return s.trace_id.hex()
+        return ""
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+# --- sampling profiler --------------------------------------------------------
+
+
+class ProfileResult:
+    """Aggregated collapsed stacks from one profiling run."""
+
+    def __init__(self, hz: int):
+        self.hz = hz
+        self.samples = 0  # sampling rounds completed
+        self.stacks: collections.Counter = collections.Counter()
+
+    def add(self, stack: tuple[str, ...]) -> None:
+        self.stacks[stack] += 1
+
+    def folded(self) -> str:
+        """flamegraph.pl / speedscope folded-stack text, hottest first."""
+        lines = [
+            f"{';'.join(stack)} {count}"
+            for stack, count in sorted(
+                self.stacks.items(), key=lambda kv: -kv[1]
+            )
+        ]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def speedscope(self) -> dict:
+        """speedscope 'sampled' profile (https://www.speedscope.app)."""
+        frame_index: dict[str, int] = {}
+        samples: list[list[int]] = []
+        weights: list[int] = []
+        for stack, count in self.stacks.items():
+            samples.append(
+                [frame_index.setdefault(f, len(frame_index)) for f in stack]
+            )
+            weights.append(count)
+        total = sum(weights)
+        return {
+            "$schema": "https://www.speedscope.app/file-format-schema.json",
+            "name": "garage-tpu profile",
+            "exporter": "garage-tpu flight recorder",
+            "activeProfileIndex": 0,
+            "shared": {"frames": [{"name": f} for f in frame_index]},
+            "profiles": [
+                {
+                    "type": "sampled",
+                    "name": f"{self.samples} rounds @ {self.hz} Hz",
+                    "unit": "none",
+                    "startValue": 0,
+                    "endValue": total,
+                    "samples": samples,
+                    "weights": weights,
+                }
+            ],
+        }
+
+
+class SamplingProfiler:
+    """One profiling run: a daemon thread sampling thread stacks + the
+    asyncio task set at `hz` until the deadline."""
+
+    def __init__(self, loop, hz: int = 100):
+        self.loop = loop
+        self.result = ProfileResult(hz)
+        self._stop = False
+        self._own_ident: int | None = None
+
+    def run(self, seconds: float) -> None:
+        self._own_ident = threading.get_ident()
+        interval = 1.0 / self.result.hz
+        deadline = time.monotonic() + seconds
+        while not self._stop and time.monotonic() < deadline:
+            self._sample()
+            time.sleep(interval)
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def _sample(self) -> None:
+        res = self.result
+        res.samples += 1
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid == self._own_ident:
+                continue
+            root = "thread:" + names.get(tid, str(tid)).replace(";", ",")
+            res.add(tuple([root] + _thread_stack(frame)))
+        # suspended asyncio tasks: where is everything parked?
+        for task in _all_tasks(self.loop):
+            try:
+                frames = _task_frames(task)
+            except Exception:  # noqa: BLE001
+                continue
+            if not frames:
+                continue  # running task, covered by the thread sample
+            res.add(
+                tuple([_task_label(task)] + [_format_frame(f) for f in frames])
+            )
+
+
+async def profile(seconds: float, hz: int = 100, loop=None) -> ProfileResult:
+    """Profile this process for `seconds` without blocking the loop.
+    Inputs are coerced and clamped here (seconds 0.05..60, hz 1..1000)
+    so the admin HTTP and RPC front-ends share one bounds policy."""
+    seconds = min(max(float(seconds), 0.05), 60.0)
+    loop = loop or asyncio.get_running_loop()
+    prof = SamplingProfiler(loop, hz=max(1, min(int(hz), 1000)))
+    t = threading.Thread(
+        target=prof.run, args=(float(seconds),),
+        name="garage-profiler", daemon=True,
+    )
+    t.start()
+    try:
+        while t.is_alive():
+            await asyncio.sleep(0.02)
+    finally:
+        prof.stop()
+        t.join(timeout=2.0)
+    return prof.result
+
+
+# --- event-loop watchdog ------------------------------------------------------
+
+
+class EventLoopWatchdog:
+    """Continuous event-loop scheduling-lag monitor + stall detector.
+
+    Loop side: a self-rescheduling `call_later(tick)` beat observes its
+    own lag into the `event_loop_lag_seconds` histogram.  Thread side: a
+    monitor wakes every `tick` and, when the beat is overdue by more
+    than `threshold`, counts a stall (`event_loop_blocked_total`, once
+    per episode) and dumps the loop thread's current stack plus every
+    live asyncio task stack — while the loop is still wedged, which is
+    the only moment the culprit is on-stack."""
+
+    def __init__(
+        self,
+        threshold: float = 0.25,
+        tick: float = 0.1,
+        dump_interval: float = 30.0,
+    ):
+        self.threshold = float(threshold)
+        self.tick = float(tick)
+        self.dump_interval = float(dump_interval)
+        self._loop = None
+        self._loop_ident: int | None = None
+        self._handle = None
+        self._thread: threading.Thread | None = None
+        self._stopped = False
+        self._stalled = False
+        self._last_beat = 0.0
+        self._expected = 0.0
+        self._last_dump = 0.0
+        # declared before the first observe so the family renders with
+        # standard histogram exposition (`_sum`, not `_seconds_total`)
+        registry.set_buckets("event_loop_lag_seconds", BUCKETS)
+
+    def start(self, loop=None) -> None:
+        self._loop = loop or asyncio.get_event_loop()
+        self._loop_ident = threading.get_ident()
+        now = time.monotonic()
+        self._last_beat = now
+        self._expected = now + self.tick
+        self._handle = self._loop.call_later(self.tick, self._beat)
+        self._thread = threading.Thread(
+            target=self._monitor, name="garage-loop-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+    # --- loop side: lag histogram --------------------------------------------
+
+    def _beat(self) -> None:
+        now = time.monotonic()
+        lag = max(0.0, now - self._expected)
+        registry.observe("event_loop_lag_seconds", (), lag)
+        self._last_beat = now
+        self._expected = now + self.tick
+        if not self._stopped:
+            self._handle = self._loop.call_later(self.tick, self._beat)
+
+    # --- thread side: stall detection ----------------------------------------
+
+    def _monitor(self) -> None:
+        while not self._stopped:
+            time.sleep(self.tick)
+            overdue = time.monotonic() - self._last_beat - self.tick
+            if overdue > self.threshold:
+                if not self._stalled:
+                    self._stalled = True
+                    registry.incr("event_loop_blocked_total", ())
+                    self._report(overdue)
+            else:
+                self._stalled = False
+
+    def _report(self, overdue: float) -> None:
+        now = time.monotonic()
+        if now - self._last_dump < self.dump_interval:
+            logger.warning(
+                "event loop blocked for %.0f ms (threshold %.0f ms); "
+                "task dump suppressed (rate limit)",
+                overdue * 1000, self.threshold * 1000,
+            )
+            return
+        self._last_dump = now
+        parts = [
+            f"event loop blocked for {overdue * 1000:.0f} ms "
+            f"(threshold {self.threshold * 1000:.0f} ms)"
+        ]
+        culprit = sys._current_frames().get(self._loop_ident)
+        if culprit is not None:
+            parts.append("blocked in (loop thread stack, innermost last):")
+            parts.extend("    " + f for f in _thread_stack(culprit))
+        tasks = _all_tasks(self._loop)
+        parts.append(f"live asyncio tasks ({len(tasks)}):")
+        for task in tasks:
+            try:
+                frames = _task_frames(task)
+                tid = _task_trace_id(task)
+                where = " <- ".join(
+                    _format_frame(f) for f in reversed(frames)
+                ) or "(running)"
+                parts.append(
+                    f"    {task.get_name()}"
+                    + (f" trace={tid}" if tid else "")
+                    + f": {where}"
+                )
+            except Exception:  # noqa: BLE001
+                continue
+        logger.warning("%s", "\n".join(parts))
+
+
+# --- slow-request flight recorder ---------------------------------------------
+
+
+class SlowRequestRecorder:
+    """Bounded ring buffer of the span trees of recent slow requests.
+
+    Registered as a tracer span-end hook (which by itself enables span
+    creation — no OTLP sink needed).  Spans buffer per trace id; when a
+    local root ends (no parent: the API request span on the serving
+    node, or a manually-opened root), its subtree is extracted and, if
+    the root exceeded `threshold_ms`, retained in a `top_k`-deep ring
+    (most recent K slow requests; `snapshot()` orders by duration).
+    Orphan trees — e.g. `rpc-handle:*` subtrees on a remote node whose
+    root lives on the gateway — finalize via the expiry sweep instead."""
+
+    SWEEP_EVERY = 512  # hook calls between pending-expiry sweeps
+    MAX_PENDING_TRACES = 1024
+    MAX_SPANS_PER_TRACE = 512
+    PENDING_TTL = 30.0  # seconds a parentless subtree may linger
+
+    def __init__(self, threshold_ms: float = 500.0, top_k: int = 64):
+        self.threshold_ms = float(threshold_ms)
+        self.top_k = int(top_k)
+        self.records: collections.deque = collections.deque(maxlen=self.top_k)
+        # trace id -> [last_touch_monotonic, [spans]]
+        self.pending: dict[bytes, list] = {}
+        self.dropped = 0  # spans discarded by the per-trace cap
+        self._calls = 0
+
+    # the tracer hook — called on the event loop for every finished span
+    def on_span_end(self, span) -> None:
+        self._calls += 1
+        if self._calls % self.SWEEP_EVERY == 0:
+            self._sweep()
+        ent = self.pending.get(span.trace_id)
+        if ent is None:
+            if len(self.pending) >= self.MAX_PENDING_TRACES:
+                # evict the oldest-inserted trace (dict order, O(1) — no
+                # full scan on the hot path), finalizing it the same way
+                # the TTL sweep would: a slow subtree must not vanish
+                # just because the node is busy
+                self._expire(next(iter(self.pending)))
+            ent = self.pending[span.trace_id] = [time.monotonic(), []]
+        ent[0] = time.monotonic()
+        if len(ent[1]) < self.MAX_SPANS_PER_TRACE:
+            ent[1].append(span)
+        else:
+            self.dropped += 1
+        if span.parent_id is None:
+            self._finalize(span)
+
+    def _finalize(self, root) -> None:
+        ent = self.pending.get(root.trace_id)
+        if ent is None:
+            return
+        spans = ent[1]
+        # extract the subtree under `root` (other local roots of the same
+        # trace, if any, keep buffering until they end or expire)
+        children: dict[bytes, list] = {}
+        for s in spans:
+            if s.parent_id is not None:
+                children.setdefault(s.parent_id, []).append(s)
+        tree, frontier = [root], [root.span_id]
+        while frontier:
+            kids = children.pop(frontier.pop(), [])
+            tree.extend(kids)
+            frontier.extend(k.span_id for k in kids)
+        tree_ids = {id(s) for s in tree}
+        rest = [s for s in spans if id(s) not in tree_ids]
+        if rest:
+            ent[1] = rest
+        else:
+            del self.pending[root.trace_id]
+        self._maybe_record(root, tree)
+
+    def _maybe_record(self, root, tree) -> None:
+        duration_ms = (root.end_ns - root.start_ns) / 1e6
+        if duration_ms < self.threshold_ms:
+            return
+        t0 = root.start_ns
+        self.records.append(
+            {
+                "traceId": root.trace_id.hex(),
+                "name": root.name,
+                "start": root.start_ns / 1e9,
+                "durationMs": round(duration_ms, 3),
+                "ok": root.ok,
+                "attrs": {k: str(v) for k, v in root.attrs.items()},
+                "spans": [
+                    {
+                        "name": s.name,
+                        "spanId": s.span_id.hex(),
+                        "parentSpanId": s.parent_id.hex()
+                        if s.parent_id
+                        else None,
+                        "startMs": round((s.start_ns - t0) / 1e6, 3),
+                        "durationMs": round((s.end_ns - s.start_ns) / 1e6, 3),
+                        "ok": s.ok,
+                        "attrs": {k: str(v) for k, v in s.attrs.items()},
+                    }
+                    for s in sorted(tree, key=lambda s: s.start_ns)
+                ],
+            }
+        )
+
+    def _sweep(self) -> None:
+        """Expire parentless trees (remote `rpc-handle:*` subtrees, or
+        abandoned spans): record the topmost span if it was slow."""
+        now = time.monotonic()
+        for tid in [
+            t for t, ent in self.pending.items()
+            if now - ent[0] > self.PENDING_TTL
+        ]:
+            self._expire(tid)
+
+    def _expire(self, tid: bytes) -> None:
+        """Finalize a pending trace that will never see a local root:
+        the topmost local span (the one whose parent is remote or gone)
+        stands in as the root."""
+        ent = self.pending.pop(tid, None)
+        if ent is None:
+            return
+        spans = ent[1]
+        local_ids = {s.span_id for s in spans}
+        tops = [s for s in spans if s.parent_id not in local_ids]
+        if tops:
+            root = max(tops, key=lambda s: s.end_ns - s.start_ns)
+            self._maybe_record(root, spans)
+
+    def snapshot(self) -> list[dict]:
+        """Retained slow requests, slowest first."""
+        return sorted(self.records, key=lambda r: -r["durationMs"])
+
+
+def slow_response(recorder: "SlowRequestRecorder | None") -> dict:
+    """The one serialization of the slow-request state, shared by the
+    admin HTTP endpoint and the admin RPC op (so key casing cannot
+    drift between the two transports)."""
+    return {
+        "enabled": recorder is not None,
+        "thresholdMs": recorder.threshold_ms if recorder else None,
+        "topK": recorder.top_k if recorder else None,
+        "requests": recorder.snapshot() if recorder else [],
+    }

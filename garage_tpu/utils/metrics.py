@@ -104,10 +104,22 @@ class Metrics:
         return float("inf")
 
     def render(self) -> list[str]:
+        """Prometheus exposition lines.  Every family gets a `# TYPE`
+        declaration before its first sample (the registry knows the
+        instrument kind), so the output survives a strict format lint —
+        asserted by the metrics-lint test against a live node."""
         lines = []
+        last = None
         for (name, labels), v in sorted(self.counters.items()):
+            if name != last:
+                lines.append(f"# TYPE {name} counter")
+                last = name
             lines.append(f"{name}{_fmt(labels)} {v:g}")
+        last = None
         for (name, labels), (n, total, buckets) in sorted(self.durations.items()):
+            if name != last:
+                lines.append(f"# TYPE {name} histogram")
+                last = name
             bs = self._family_buckets.get(name, BUCKETS)
             acc = 0
             for i, c in enumerate(buckets[:-1]):
@@ -127,7 +139,11 @@ class Metrics:
                 gauges[(name, labels)] = float(fn())
             except Exception:  # noqa: BLE001 — a dead gauge must not kill scrape
                 continue
+        last = None
         for (name, labels), v in sorted(gauges.items()):
+            if name != last:
+                lines.append(f"# TYPE {name} gauge")
+                last = name
             lines.append(f"{name}{_fmt(labels)} {v:g}")
         return lines
 

@@ -29,10 +29,16 @@ import asyncio
 import contextvars
 import logging
 import os
+import random
 import time
 from contextlib import contextmanager
 
 logger = logging.getLogger("garage.tracing")
+
+# span/trace ids only need uniqueness, not unpredictability; a seeded
+# PRNG avoids two getrandom() syscalls per span on the hot path (the
+# flight recorder keeps span creation on by default)
+_ids = random.Random(int.from_bytes(os.urandom(16), "big") ^ os.getpid())
 
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "garage_current_span", default=None
@@ -82,8 +88,10 @@ class Span:
 
     def __init__(self, name: str, parent: "Span | RemoteParent | None", attrs: dict):
         self.name = name
-        self.trace_id = parent.trace_id if parent else os.urandom(16)
-        self.span_id = os.urandom(8)
+        self.trace_id = (
+            parent.trace_id if parent else _ids.getrandbits(128).to_bytes(16, "big")
+        )
+        self.span_id = _ids.getrandbits(64).to_bytes(8, "big")
         self.parent_id = parent.span_id if parent else None
         self.start_ns = time.time_ns()
         self.end_ns = 0
@@ -98,10 +106,25 @@ class Tracer:
         self._buf: list[Span] = []
         self._task: asyncio.Task | None = None
         self._session = None
+        # span-end hooks (utils/flight.py SlowRequestRecorder): attaching
+        # one enables span creation even without an export sink, so the
+        # flight recorder works with zero external collectors
+        self._hooks: list = []
 
     @property
     def enabled(self) -> bool:
-        return self.sink is not None
+        return self.sink is not None or bool(self._hooks)
+
+    def add_hook(self, fn) -> None:
+        """Register fn(span), called once per finished span."""
+        if fn not in self._hooks:
+            self._hooks.append(fn)
+
+    def remove_hook(self, fn) -> None:
+        try:
+            self._hooks.remove(fn)
+        except ValueError:
+            pass
 
     def configure(self, sink: str | None, service_name: str = "garage-tpu") -> None:
         self.sink = sink
@@ -155,8 +178,15 @@ class Tracer:
         finally:
             _current.reset(token)
             s.end_ns = time.time_ns()
-            if len(self._buf) < MAX_BUFFER:
+            # export buffer fills only when a sink is configured; hooks
+            # (flight recorder) see every span either way
+            if self.sink is not None and len(self._buf) < MAX_BUFFER:
                 self._buf.append(s)
+            for hook in self._hooks:
+                try:
+                    hook(s)
+                except Exception as e:  # noqa: BLE001 — hooks must not fail spans
+                    logger.debug("span hook failed: %r", e)
 
     def current(self) -> Span | None:
         return _current.get()

@@ -86,6 +86,103 @@ def test_daemon_metrics_endpoint_has_gauges_and_histograms(tmp_path):
     run(main())
 
 
+def test_metrics_exposition_lint(tmp_path):
+    """Satellite: /metrics from a live node parses as clean Prometheus
+    exposition — every family declares `# TYPE` before its first sample,
+    no family is declared twice (the old inline/registry duplication of
+    the resync/merkle/gc queue gauges), no duplicate (name, labelset)
+    pairs, and the bare `worker_errors` gauge is gone in favour of the
+    registry-backed `worker_*` families."""
+    import re
+
+    from test_s3_api import make_client, make_daemon, teardown
+
+    from garage_tpu.api.admin.api_server import AdminApiServer
+
+    NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s(\S+)$")
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        admin = AdminApiServer(garage)
+        await admin.start("127.0.0.1", 0)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("lint")
+            await client.put_object("lint", "k", b"z" * 9_000)
+            await client.get_object("lint", "k")
+            await asyncio.sleep(0.3)  # watchdog beats + worker iterations
+
+            import aiohttp
+
+            port = admin.runner.addresses[0][1]
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{port}/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
+
+            types: dict[str, str] = {}
+            seen_samples: set[tuple[str, str]] = set()
+            samples_started: set[str] = set()
+            for lineno, line in enumerate(text.splitlines(), 1):
+                if not line.strip():
+                    continue
+                if line.startswith("# TYPE "):
+                    _, _, rest = line.partition("# TYPE ")
+                    fam, typ = rest.rsplit(" ", 1)
+                    assert NAME_RE.match(fam), line
+                    assert typ in ("counter", "gauge", "histogram"), line
+                    assert fam not in types, f"family {fam} declared twice"
+                    assert fam not in samples_started, (
+                        f"TYPE for {fam} after its samples"
+                    )
+                    types[fam] = typ
+                    continue
+                if line.startswith("#"):
+                    continue
+                m = SAMPLE_RE.match(line)
+                assert m, f"line {lineno} unparseable: {line!r}"
+                name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+                float(value)  # parses as a number
+                key = (name, labels)
+                assert key not in seen_samples, f"duplicate sample {key}"
+                seen_samples.add(key)
+                # resolve the family: exact name, else histogram suffixes
+                fam = name if name in types else None
+                if fam is None:
+                    for suf in ("_bucket", "_count", "_sum", "_seconds_total"):
+                        base = name.removesuffix(suf)
+                        if base != name and types.get(base) == "histogram":
+                            fam = base
+                            break
+                assert fam is not None, f"sample {name} has no TYPE family"
+                samples_started.add(fam)
+
+            # the formerly-duplicated families exist exactly once, from
+            # the registry
+            for fam in (
+                "block_resync_queue_length",
+                "table_merkle_updater_todo_queue_length",
+                "table_gc_todo_queue_length",
+                "cluster_connected_nodes",
+            ):
+                assert fam in types, fam
+            # registry-backed per-worker health replaces bare worker_errors
+            assert "worker_errors" not in types
+            for fam in ("worker_errors_total", "worker_state", "worker_queue_length"):
+                assert fam in types, fam
+            assert 'worker_queue_length{worker="resync:0"' in text
+            # the watchdog's lag histogram renders in standard form
+            assert types.get("event_loop_lag_seconds") == "histogram"
+            assert "event_loop_lag_seconds_bucket" in text
+            assert "event_loop_lag_seconds_sum" in text
+        finally:
+            await admin.stop()
+            await teardown(garage, s3)
+
+    run(main())
+
+
 def test_tracer_spans_nest_and_export():
     """Spans nest via contextvars and export OTLP/HTTP JSON to the sink."""
     from aiohttp import web
