@@ -279,6 +279,29 @@ class AdminApiServer:
                     results.append({"success": False, "error": str(e)})
             return web.json_response(results)
 
+        if path == "/v1/repair/plan" and request.method == "GET":
+            # repair plane (block/repair_plan.py): plan state, backlog by
+            # urgency class, progress counters, admission-control knobs
+            return web.json_response(g.repair_plan_status())
+        if path == "/v1/repair/plan/launch" and request.method == "POST":
+            body = await request.json() if request.can_read_body else {}
+            try:
+                g.launch_repair_plan(fresh=bool(body.get("fresh")))
+            except ValueError as e:
+                # already running / replica codec: a client error, not a
+                # server fault (mirrors the cancel endpoint's 400)
+                return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(g.repair_plan_status())
+        if path == "/v1/repair/plan/cancel" and request.method == "POST":
+            p = g.repair_planner
+            if p is None or p.finished:
+                return web.json_response(
+                    {"cancelled": False, "error": "no repair plan running"},
+                    status=400,
+                )
+            p.cmd_cancel()
+            return web.json_response({"cancelled": True})
+
         if path == "/v1/node" and request.method == "GET":
             # GetNodeInfo: the node answering the request (not the
             # cluster): identity, version, engine, data/metadata dirs.

@@ -426,6 +426,30 @@ class BlockManager:
         if op[0] == "Pieces":
             hash32 = bytes(op[1])
             return Resp(sorted(self.local_pieces(hash32).keys()))
+        if op[0] == "Inv":
+            # bulk piece inventory (repair-plane survey, block/repair_plan.py):
+            # one RPC answers for hundreds of hashes what "Pieces" answers
+            # for one — [[piece_indices], piece_payload_len] per hash
+            out = []
+            for h in op[1]:
+                h = bytes(h)
+                pieces = self.local_pieces(h)
+                plen = 0
+                for _pi, (path, compressed) in sorted(pieces.items()):
+                    if compressed:
+                        continue  # legacy .zst replica file: size lies
+                    from .repair_plan import _stored_piece_len
+
+                    plen = _stored_piece_len(path)
+                    break
+                out.append([sorted(pieces.keys()), plen])
+            return Resp(out)
+        if op[0] == "Queue":
+            # bulk resync nudge: a remote planner found stripes whose
+            # missing ranks live HERE; this node's resync heals them
+            hashes = [bytes(h) for h in op[1]]
+            self.resync.queue_blocks(hashes)
+            return Resp(len(hashes))
         raise Error(f"unknown block op {op[0]!r}")
 
     # --- cluster ops ----------------------------------------------------------
@@ -676,11 +700,16 @@ class BlockManager:
         pieces: dict[int, bytes] = {}
         block_len = -1
         errors: list[str] = []
-        fetches = [
+        # first want_k ranks, widened past rank k-1 when exclude_self
+        # knocks our own rank out — otherwise every repair gather (self is
+        # a holder by definition) fell to the ask-every-node slow path,
+        # one extra RPC round per block in a 10k-block repair plan
+        cand = [
             (i, nodes[i])
-            for i in range(min(want_k, len(nodes)))
+            for i in range(min(self.codec.n_pieces, len(nodes)))
             if not (exclude_self and nodes[i] == self.system.id)
         ]
+        fetches = cand[:want_k]
         results = await asyncio.gather(
             *[
                 self._fetch_piece(n, hash32, i, prio, order_tag=order_tag)

@@ -74,7 +74,12 @@ class RepairWorker(Worker):
             self.cursor = None
             return WorkerState.BUSY
         if ec and batch:
-            self.rebuilt += await self.manager.bulk_reconstruct(batch)
+            # same driver + metric families as the repair planner
+            # (block/repair_plan.py), so `repair blocks` rounds land in
+            # repair_plan_batch_size / repair_plan_blocks_total too
+            from .repair_plan import drive_bulk
+
+            self.rebuilt += await drive_bulk(self.manager, batch)
         return WorkerState.BUSY
 
 

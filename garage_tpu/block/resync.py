@@ -55,6 +55,15 @@ class BlockResyncManager:
             self.queue.insert(key, b"")
         self._kick.set()
 
+    def queue_blocks(self, hashes: list[bytes], delay_ms: int = 0) -> None:
+        """Bulk enqueue (repair-plane `Queue` nudges, gather failures):
+        one kick instead of one per hash."""
+        when = (now_msec() + delay_ms).to_bytes(8, "big")
+        for h in hashes:
+            self.queue.insert(when + h, b"")
+        if hashes:
+            self._kick.set()
+
     def queue_len(self) -> int:
         return len(self.queue)
 

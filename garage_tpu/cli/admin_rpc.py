@@ -540,6 +540,21 @@ class AdminRpcHandler:
             else:
                 raise ValueError(f"unknown scrub command {cmd!r}")
             return {"scrub": sw.status()}
+        elif what == "plan":
+            # repair plane (block/repair_plan.py): status/launch/cancel
+            cmd = args.get("cmd", "status")
+            if cmd == "status":
+                return self.garage.repair_plan_status()
+            if cmd == "launch":
+                self.garage.launch_repair_plan(fresh=bool(args.get("fresh")))
+                return self.garage.repair_plan_status()
+            if cmd == "cancel":
+                p = self.garage.repair_planner
+                if p is None or p.finished:
+                    raise ValueError("no repair plan running")
+                p.cmd_cancel()
+                return "repair plan cancelled"
+            raise ValueError(f"unknown plan command {cmd!r}")
         else:
             raise ValueError(f"unknown repair target {what!r}")
         return f"repair {what} launched"

@@ -165,13 +165,20 @@ def main(argv=None):
     rep.add_argument(
         "what",
         choices=["blocks", "rebalance", "tables", "versions", "mpu",
-                 "block-refs", "scrub"],
+                 "block-refs", "scrub", "plan"],
     )
     rep.add_argument(
-        "scrub_cmd", nargs="?",
-        choices=["start", "pause", "resume", "cancel", "set-tranquility"],
+        "sub_cmd", nargs="?",
+        choices=["start", "pause", "resume", "cancel", "set-tranquility",
+                 "status", "launch"],
+        help="scrub: start|pause|resume|cancel|set-tranquility; "
+             "plan: status|launch|cancel",
     )
-    rep.add_argument("scrub_value", nargs="?")
+    rep.add_argument("sub_value", nargs="?")
+    rep.add_argument(
+        "--fresh", action="store_true",
+        help="plan launch: discard a checkpointed plan and rescan",
+    )
     meta = sub.add_parser("meta")
     meta.add_argument("meta_cmd", choices=["snapshot"])
     cdb = sub.add_parser("convert-db", help="copy the metadata db between engines")
@@ -698,9 +705,30 @@ async def dispatch(args, call, config) -> str | None:
     if args.cmd == "repair":
         a = {"what": args.what}
         if args.what == "scrub":
-            a["cmd"] = args.scrub_cmd or "start"
-            if args.scrub_value is not None:
-                a["value"] = args.scrub_value
+            a["cmd"] = args.sub_cmd or "start"
+            if args.sub_value is not None:
+                a["value"] = args.sub_value
+        if args.what == "plan":
+            a["cmd"] = args.sub_cmd or "status"
+            if args.fresh:
+                a["fresh"] = True
+            r = await call("repair", a)
+            if isinstance(r, dict):
+                if jd:
+                    return jd(r)
+                rows = [
+                    f"running\t{r.get('running')}",
+                    f"state\t{r.get('state', '-')}",
+                    f"backlog\t{r.get('backlog', 0)}",
+                    f"repaired\t{r.get('repaired', 0)}",
+                    f"rounds\t{r.get('rounds', 0)}",
+                    f"nudged\t{r.get('nudged', 0)}",
+                    f"lost\t{r.get('lost', 0)}",
+                ]
+                for u, n in (r.get("backlogByUrgency") or {}).items():
+                    rows.append(f"backlog[{u}]\t{n}")
+                return format_table(rows)
+            return str(r)
         return str(await call("repair", a))
 
     if args.cmd == "meta" and args.meta_cmd == "snapshot":

@@ -306,6 +306,31 @@ class Garage:
             lambda: str(self.tables[0].syncer.anti_entropy_interval),
             _set_sync_interval,
         )
+
+        # repair plane (block/repair_plan.py): knob object shared with a
+        # running planner so `worker set` changes apply on the next round
+        from ..block.repair_plan import PlanParams
+
+        self.repair_params = PlanParams(
+            tranquility=config.repair.tranquility,
+            bytes_in_flight=config.repair.bytes_in_flight,
+            batch_blocks=config.repair.batch_blocks,
+        )
+        self.repair_planner = None
+        self.bg_vars.register_rw(
+            "repair-tranquility",
+            lambda: str(self.repair_params.tranquility),
+            lambda v: setattr(
+                self.repair_params, "tranquility", max(0, int(v))
+            ),
+        )
+        self.bg_vars.register_rw(
+            "repair-bytes-in-flight",
+            lambda: str(self.repair_params.bytes_in_flight),
+            lambda v: setattr(
+                self.repair_params, "bytes_in_flight", max(1, int(v))
+            ),
+        )
         self.bg = BackgroundRunner()
         # flight recorder plane (utils/flight.py), wired in start()
         self.flight_recorder = None
@@ -406,6 +431,58 @@ class Garage:
         self.bg.spawn(LifecycleWorker(self, metadata_dir=self.config.metadata_dir))
         if self.config.metadata_auto_snapshot_interval:
             self.bg.spawn(SnapshotWorker(self))
+        # restart-safe repair plane: a plan checkpointed mid-flight by a
+        # previous process resumes (ledger + cursor intact) instead of
+        # rescanning the cluster
+        from ..block.repair_plan import RepairPlanner
+
+        if (
+            self.config.repair.auto_resume
+            and self.block_manager.codec.n_pieces > 1
+            and RepairPlanner.resumable(self.config.metadata_dir)
+        ):
+            self.launch_repair_plan()
+
+    # --- repair plane ---------------------------------------------------------
+
+    def launch_repair_plan(self, fresh: bool = False):
+        """Start (or resume) the batched-reconstruction planner; admin
+        `POST /v1/repair/plan/launch` and `cli repair plan launch`."""
+        from ..block.repair_plan import RepairPlanner
+
+        if self.block_manager.codec.n_pieces <= 1:
+            raise ValueError(
+                "repair planner requires an erasure-coded block codec "
+                "(replication_mode = ec:k:m)"
+            )
+        if self.repair_planner is not None and not self.repair_planner.finished:
+            raise ValueError("a repair plan is already running")
+        planner = RepairPlanner(
+            self.block_manager,
+            metadata_dir=self.config.metadata_dir,
+            params=self.repair_params,
+            fresh=fresh,
+        )
+        self.repair_planner = planner
+        self.bg.spawn(planner)
+        return planner
+
+    def repair_plan_status(self) -> dict:
+        from ..block.repair_plan import RepairPlanner
+
+        p = self.repair_planner
+        out: dict = {"running": p is not None and not p.finished}
+        if p is not None:
+            out.update(p.status_full())
+            out["resumed"] = p.resumed
+        else:
+            out["resumable"] = RepairPlanner.resumable(self.config.metadata_dir)
+        out["params"] = {
+            "tranquility": self.repair_params.tranquility,
+            "bytesInFlight": self.repair_params.bytes_in_flight,
+            "batchBlocks": self.repair_params.batch_blocks,
+        }
+        return out
 
     async def stop(self) -> None:
         from ..utils.tracing import tracer

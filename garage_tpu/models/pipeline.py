@@ -128,7 +128,10 @@ class ScrubRepairPipeline:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        key = ("sharded", id(mesh))
+        # keyed by the Mesh itself (hashable): an id() key could collide
+        # when a GC'd mesh's id is reused, returning a compiled step bound
+        # to dead devices
+        key = ("sharded", mesh)
         if key not in self._fns:
             self._fns[key] = self.sharded_step(mesh)
         step = self._fns[key]

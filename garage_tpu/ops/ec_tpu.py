@@ -221,7 +221,15 @@ def ec_apply_fn_mesh(
     mesh = make_mesh(n_devices, axis=axis)
     plat = platform or jax.default_backend()
     body = _ec_body(plat, impl)
-    fn = jax.shard_map(
+    # jax >= 0.5 exports shard_map at top level; 0.4.x only under
+    # experimental.  Resolving both keeps the mesh path REAL on older
+    # builds — an AttributeError here used to silently demote every
+    # "mesh" dispatch to single-device (the fallback ate it), which is
+    # exactly what tpu_mesh_engaged_total now makes visible.
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    fn = shard_map(
         body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis)
     )
     return jax.jit(fn), mesh
@@ -290,9 +298,9 @@ class EcTpu:
             kernel, telemetry.resolved_platform(self.platform),
             x.shape[0], x.nbytes,
         ):
-            return self._apply_inner(bitmat, x)
+            return self._apply_inner(bitmat, x, kernel)
 
-    def _apply_inner(self, bitmat, x: np.ndarray) -> np.ndarray:
+    def _apply_inner(self, bitmat, x: np.ndarray, kernel: str = "ec") -> np.ndarray:
         n = self._mesh_width()
         # auto-detected meshes only engage once every device gets >=2
         # blocks; an explicitly pinned width engages as soon as padding
@@ -300,7 +308,11 @@ class EcTpu:
         min_batch = 2 * n if self._n_dev is None else n
         if n > 1 and x.shape[0] >= min_batch:
             try:
-                return self._apply_mesh(bitmat, x, n)
+                out = self._apply_mesh(bitmat, x, n)
+                telemetry.mesh_engaged(
+                    kernel, telemetry.resolved_platform(self.platform), n
+                )
+                return out
             except Exception as e:  # noqa: BLE001 — mesh path optional
                 if not self._mesh_warned:
                     self._mesh_warned = True

@@ -21,6 +21,14 @@ endpoint via utils/metrics.py; catalogued in doc/monitoring.md):
                                                  says {platform="cpu"} is
                                                  the five-round bug class
                                                  this plane exists for
+  tpu_mesh_engaged_total{kernel,platform,devices}  dispatches actually
+                                                 served by the multi-
+                                                 device shard_map mesh
+                                                 (vs falling back to a
+                                                 single device) — the
+                                                 repair planner's batch
+                                                 coalescing exists to
+                                                 make this advance
 """
 
 from __future__ import annotations
@@ -57,6 +65,21 @@ def note_platform(platform: str) -> None:
     _platforms_seen.add(platform)
     registry.register_gauge(
         "jax_backend_platform", (("platform", platform),), lambda: 1.0
+    )
+
+
+def mesh_engaged(kernel: str, platform: str, devices: int) -> None:
+    """Count one dispatch that actually ran on the multi-device mesh
+    path.  Recorded by EcTpu AFTER the mesh call returns (a mesh attempt
+    that fell back to single-device must not count — the whole point is
+    distinguishing the two)."""
+    registry.incr(
+        "tpu_mesh_engaged_total",
+        (
+            ("kernel", kernel),
+            ("platform", platform),
+            ("devices", str(devices)),
+        ),
     )
 
 

@@ -97,6 +97,18 @@ class KubernetesDiscoveryConfig:
 
 
 @dataclass
+class RepairPlanConfig:
+    """Rebuild-specific: admission-control defaults for the repair plane
+    (block/repair_plan.py) — runtime-tunable via `worker set
+    repair-tranquility` / `repair-bytes-in-flight`."""
+
+    tranquility: int = 2  # Tranquilizer pacing between rounds (0 = flat out)
+    bytes_in_flight: int = 128 * 1024 * 1024  # surviving-shard bytes / round
+    batch_blocks: int | None = None  # None: 2x device mesh, min 256
+    auto_resume: bool = True  # resume a checkpointed plan at daemon start
+
+
+@dataclass
 class TpuConfig:
     """Rebuild-specific: the TPU compute plane used by the EC block codec and
     batched scrub hashing (no analog in the reference)."""
@@ -164,6 +176,7 @@ class Config:
     s3_web: WebConfig = field(default_factory=WebConfig)
     admin: AdminConfig = field(default_factory=AdminConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
+    repair: RepairPlanConfig = field(default_factory=RepairPlanConfig)
     consul_discovery: ConsulDiscoveryConfig | None = None
     kubernetes_discovery: KubernetesDiscoveryConfig | None = None
 
@@ -372,6 +385,8 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             cfg.admin = AdminConfig(**_known(v, AdminConfig))
         elif k == "tpu":
             cfg.tpu = TpuConfig(**_known(v, TpuConfig))
+        elif k == "repair":
+            cfg.repair = RepairPlanConfig(**_known(v, RepairPlanConfig))
         elif k == "consul_discovery":
             cfg.consul_discovery = ConsulDiscoveryConfig(
                 **_known(v, ConsulDiscoveryConfig)
@@ -381,6 +396,14 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
                 **_known(v, KubernetesDiscoveryConfig)
             )
         # unknown sections are ignored (forward compat)
+    # metadata_fsync is tri-state, not stringly-typed: anything else (a
+    # "goup" typo, "yes", 2) used to fall through as a truthy value and
+    # silently select per-commit sync — validate at load, fail loudly
+    if cfg.metadata_fsync not in (True, False, "group"):
+        raise ValueError(
+            f"invalid metadata_fsync {cfg.metadata_fsync!r}: accepted values "
+            'are true, false, or "group" (group commit, native engine only)'
+        )
     # resolve secrets
     cfg.rpc_secret = _get_secret(
         cfg.rpc_secret,

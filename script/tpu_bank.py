@@ -10,7 +10,10 @@ subprocess:
   1. bench.py            (encode ladder — banks the headline number)
   2. bench.py --repair   (reconstruction dial)
   3. bench.py --hash     (fused encode+BLAKE3 at production batch)
-  4. script/tpu_verify.py (on-chip bit-exactness suite)
+  4. bench_repair.py     (repair plane: one-node-kill 10k-block plan
+                          through the RepairPlanner -> upgrades the
+                          committed BENCH_repair_10k.json on chip)
+  5. script/tpu_verify.py (on-chip bit-exactness suite)
 
 All stdout/stderr goes to tpu_runs/bank_<ts>.log with UTC timestamps, and
 the winning JSON lines to tpu_runs/banked_<ts>.json.  After any window
@@ -58,7 +61,7 @@ def git_commit_artifacts(f, msg):
     Each path is added SEPARATELY: `git add` with several pathspecs is
     atomic, so one empty/untracked dir (a cold `.xla_cache/`) used to
     fatal the whole add and silently skip the durability commit."""
-    paths = ["tpu_runs", ".xla_cache"]
+    paths = ["tpu_runs", ".xla_cache", "BENCH_repair_10k.json"]
     try:
         added = []
         for p in paths:
@@ -120,6 +123,12 @@ def main():
                 ("encode", [py, "bench.py", "--verbose"], 600),
                 ("repair", [py, "bench.py", "--repair", "--verbose"], 600),
                 ("hash", [py, "bench.py", "--hash", "--verbose"], 600),
+                # repair plane end-to-end: only overwrites the committed
+                # artifact when the run actually happened on a chip, so
+                # a wedged window can't downgrade the banked number
+                ("repair-plan",
+                 [py, "bench_repair.py", "--verbose",
+                  "--artifact", "BENCH_repair_10k.json"], 600),
             ]
             for name, cmd, tmo in dials:
                 rc, out = run(f, name, cmd, tmo)
