@@ -70,12 +70,22 @@ class AdminApiServer:
                     status=400,
                     text=f"Domain '{domain}' is not managed by garage-tpu",
                 )
-            if path == "/metrics":
+            if path in ("/metrics", "/metrics/cluster"):
                 if self.metrics_token and not (
                     self._check_token(request, self.metrics_token)
                     or self._check_token(request, self.admin_token)
                 ):
                     return web.Response(status=403, text="forbidden")
+                if path == "/metrics/cluster":
+                    # federated exposition of the gossiped telemetry
+                    # digests: one scrape of ANY node covers the cluster
+                    # (rpc/telemetry_digest.py)
+                    from ...rpc.telemetry_digest import render_cluster_metrics
+
+                    return web.Response(
+                        text=render_cluster_metrics(self.garage),
+                        content_type="text/plain",
+                    )
                 return self._metrics()
             if not self._check_token(request, self.admin_token):
                 return web.Response(status=403, text="forbidden")
@@ -158,6 +168,10 @@ class AdminApiServer:
         m("cluster_storage_nodes_up", h.storage_nodes_up)
         m("cluster_partitions_quorum", h.partitions_quorum)
         m("cluster_partitions_all_ok", h.partitions_all_ok)
+        m(
+            "cluster_outlier_nodes", len(h.outlier_nodes),
+            "nodes MAD-flagged as outliers (see /metrics/cluster for which)",
+        )
         m("cluster_layout_version", g.layout_manager.history.current().version)
         lines.append("# TYPE table_size gauge")
         for t in g.tables:
@@ -229,8 +243,18 @@ class AdminApiServer:
                     "partitions": h.partitions,
                     "partitionsQuorum": h.partitions_quorum,
                     "partitionsAllOk": h.partitions_all_ok,
+                    "outlierNodes": h.outlier_nodes,
                 }
             )
+
+        if path == "/v1/cluster/telemetry" and request.method == "GET":
+            # cluster telemetry rollup (rpc/telemetry_digest.py): per-node
+            # digest rows + cluster aggregates + MAD outliers + SLO state,
+            # assembled entirely from gossiped state — answering this
+            # needs NO fan-out to the other nodes
+            from ...rpc.telemetry_digest import rollup
+
+            return web.json_response(rollup(g))
 
         if path == "/v1/debug/profile" and request.method == "GET":
             # flight recorder: on-demand sampling profiler (utils/flight.py).

@@ -100,7 +100,7 @@ class S3ApiServer:
         return k.secret()
 
     async def _entry(self, request: web.Request) -> web.StreamResponse:
-        from ...utils.metrics import request_metrics
+        from ...utils.metrics import registry, request_metrics
         from ...utils.tracing import tracer
 
         # correlate client-observed latency (and failures) with the
@@ -115,6 +115,15 @@ class S3ApiServer:
                 resp.headers["x-amz-request-id"] = trace_hex
             return resp
 
+        def err(status: int) -> None:
+            # status-labelled error counter: the SLO tracker and the
+            # cluster telemetry digest count code >= 500 against the
+            # availability budget (4xx are the client's errors)
+            registry.incr(
+                "api_s3_error_counter",
+                (("method", request.method), ("code", str(status))),
+            )
+
         try:
             with request_metrics(
                 "api_s3", request.method, "api:s3", path=request.path
@@ -125,6 +134,7 @@ class S3ApiServer:
         except ApiError as e:
             if e.status == 304:
                 return rid(web.Response(status=304))
+            err(e.status)
             return rid(web.Response(
                 status=e.status,
                 text=error_xml(e, request.path),
@@ -133,12 +143,14 @@ class S3ApiServer:
         except Error as e:
             msg = str(e)
             if "not found" in msg:
+                err(404)
                 return rid(web.Response(
                     status=404,
                     text=error_xml(NoSuchBucket(msg), request.path),
                     content_type="application/xml",
                 ))
             logger.exception("internal error")
+            err(500)
             return rid(web.Response(
                 status=500,
                 text=error_xml(ApiError(msg), request.path),
@@ -146,6 +158,7 @@ class S3ApiServer:
             ))
         except Exception as e:  # noqa: BLE001
             logger.exception("unhandled API error")
+            err(500)
             return rid(web.Response(
                 status=500,
                 text=error_xml(ApiError(repr(e)), request.path),

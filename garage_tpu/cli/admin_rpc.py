@@ -601,4 +601,14 @@ class AdminRpcHandler:
                 "resync_queue": g.block_manager.resync.queue_len(),
                 "resync_errors": g.block_manager.resync.errors_len(),
             },
+            # local telemetry digest (rpc/telemetry_digest.py) — the same
+            # row this node gossips to its peers
+            "telemetry": g.telemetry.collect(),
         }
+
+    async def op_cluster_telemetry(self, args) -> Any:
+        """The cluster rollup (per-node digests + aggregates + outliers
+        + SLO) over the admin mesh — `cluster top` / `cluster telemetry`."""
+        from ..rpc.telemetry_digest import rollup
+
+        return rollup(self.garage)

@@ -143,6 +143,22 @@ def main(argv=None):
     acb.add_argument("--allow-create-bucket", action="store_true", default=None)
     acb.add_argument("--deny-create-bucket", action="store_true", default=None)
 
+    clu = sub.add_parser(
+        "cluster", help="cluster-wide telemetry from the gossiped digests"
+    )
+    clu_sub = clu.add_subparsers(dest="cluster_cmd", required=True)
+    ctop = clu_sub.add_parser(
+        "top", help="live per-node table (any node answers for all)"
+    )
+    ctop.add_argument(
+        "-n", "--interval", type=float, default=2.0,
+        help="refresh interval in seconds",
+    )
+    ctop.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    clu_sub.add_parser("telemetry", help="raw cluster rollup JSON")
+
     wrk = sub.add_parser("worker")
     wrk.add_argument("worker_cmd", choices=["list", "get", "set"])
     wrk.add_argument("var", nargs="?")
@@ -388,6 +404,62 @@ async def run_cli(args) -> None:
         await app.shutdown()
 
 
+def _ms(secs) -> str:
+    return "-" if secs is None else f"{float(secs) * 1000:.1f}ms"
+
+
+def _render_cluster_top(r: dict) -> str:
+    """One frame of `cluster top`: cluster header + SLO line + one row
+    per node from the gossiped digests (rpc/telemetry_digest.py)."""
+    h = r.get("clusterHealth") or {}
+    agg = r.get("aggregate") or {}
+    outliers = r.get("outliers") or {}
+    head = [
+        f"cluster health\t{h.get('status', '?')}",
+        f"nodes\t{h.get('connected_nodes', '?')}/{h.get('known_nodes', '?')}"
+        f" connected, {r.get('nodesReporting', 0)} reporting digests",
+        f"s3\t{agg.get('s3RequestsPerSec', 0):.2f} req/s, "
+        f"{agg.get('s3ErrorsPerSec', 0):.2f} 5xx/s",
+        f"backlogs\tresync {agg.get('resyncQueue', 0):g}, "
+        f"repair {agg.get('repairBacklog', 0):g}",
+        f"outliers\t{', '.join(o[:16] for o in sorted(outliers)) or '(none)'}",
+    ]
+    slo = r.get("slo")
+    if slo:
+        head.append(
+            "slo budget\t"
+            f"avail {slo['availability']['budgetRemaining'] * 100:.1f}% "
+            f"(burn {slo['availability']['burnRate']:.2f}), "
+            f"p99 {slo['latencyP99']['budgetRemaining'] * 100:.1f}% "
+            f"(burn {slo['latencyP99']['burnRate']:.2f})"
+        )
+    out = format_table(head) + "\n\n"
+    rows = ["id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tflags"]
+    for n in r.get("nodes", []):
+        d = n.get("digest") or {}
+        s3 = d.get("s3") or {}
+        flags = []
+        if n.get("isSelf"):
+            flags.append("self")
+        if n["id"] in outliers:
+            flags.append("OUTLIER")
+        if not d:
+            flags.append("no-digest")
+        rows.append(
+            f"{n['id'][:16]}\t{n.get('hostname', '?')}\t"
+            f"{'y' if n.get('isUp') else 'n'}\t{n.get('ageSecs', 0):.0f}s\t"
+            f"{s3.get('rps', 0):.1f}\t{s3.get('eps', 0):.1f}\t"
+            f"{_ms(s3.get('p99'))}\t{_ms((d.get('loop') or {}).get('p99'))}\t"
+            f"{(d.get('resync') or {}).get('q', 0)}\t"
+            f"{(d.get('rpc') or {}).get('open', 0)}\t"
+            f"{','.join(flags) or '-'}"
+        )
+    out += format_table(rows)
+    for nid, reasons in sorted(outliers.items()):
+        out += f"\n  outlier {nid[:16]}: " + "; ".join(reasons)
+    return out
+
+
 async def dispatch(args, call, config) -> str | None:
     from ..utils.config import _parse_capacity
 
@@ -420,7 +492,73 @@ async def dispatch(args, call, config) -> str | None:
         return out
 
     if args.cmd == "stats":
-        return json.dumps(await call("stats"), indent=2, default=repr)
+        st = await call("stats")
+        if jd:
+            return jd(st)
+        rows = ["==== NODE ====", f"db engine\t{st['db_engine']}"]
+        tm = st.get("telemetry") or {}
+        if tm:
+            rows.append(f"uptime\t{tm.get('up', 0):.0f}s")
+        out = format_table(rows) + "\n\n==== TABLES ====\n"
+        trow = ["table\tentries\tmerkle todo\tgc todo"]
+        for name, t in st["tables"].items():
+            trow.append(
+                f"{name}\t{t['entries']}\t{t['merkle_todo']}\t{t['gc_todo']}"
+            )
+        out += format_table(trow) + "\n\n==== BLOCKS ====\n"
+        b = st["blocks"]
+        out += format_table(
+            [
+                f"rc entries\t{b['rc_entries']}",
+                f"resync queue\t{b['resync_queue']}",
+                f"resync errors\t{b['resync_errors']}",
+            ]
+        )
+        if tm:
+            out += "\n\n==== TELEMETRY (local digest) ====\n"
+            s3, loop_, rpc = (
+                tm.get("s3") or {}, tm.get("loop") or {}, tm.get("rpc") or {}
+            )
+            drow = [
+                f"s3 req/s\t{s3.get('rps', 0):.2f}",
+                f"s3 5xx/s\t{s3.get('eps', 0):.2f}",
+                f"s3 p50/p99\t{_ms(s3.get('p50'))} / {_ms(s3.get('p99'))}",
+                f"loop lag p99\t{_ms(loop_.get('p99'))}",
+                f"worker errors\t{(tm.get('work') or {}).get('errs', 0):g}",
+                f"breakers open\t{rpc.get('open', 0)}",
+                f"repair backlog\t{(tm.get('repair') or {}).get('backlog', 0)}",
+                f"tpu dispatch/s\t{(tm.get('tpu') or {}).get('dps', 0):.2f}",
+            ]
+            slo = tm.get("slo")
+            if slo:
+                drow.append(
+                    "slo budget (avail/lat)\t"
+                    f"{slo['avail']['rem'] * 100:.1f}% / "
+                    f"{slo['lat']['rem'] * 100:.1f}%"
+                )
+            out += format_table(drow)
+        return out
+
+    if args.cmd == "cluster":
+        if args.cluster_cmd == "telemetry":
+            return json.dumps(
+                await call("cluster-telemetry"), indent=2, default=repr
+            )
+        # cluster top: live table; --once (or --json) renders one frame
+        if args.json:
+            return json.dumps(
+                await call("cluster-telemetry"), indent=2, default=repr
+            )
+        if args.once:
+            return _render_cluster_top(await call("cluster-telemetry"))
+        try:
+            while True:
+                frame = _render_cluster_top(await call("cluster-telemetry"))
+                # clear screen + home, like top(1)
+                print("\x1b[2J\x1b[H" + frame, flush=True)
+                await asyncio.sleep(max(0.2, args.interval))
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            return None
 
     if args.cmd == "node" and args.node_cmd == "connect":
         nid, _, hostport = args.arg.partition("@")

@@ -335,6 +335,18 @@ class Garage:
         # flight recorder plane (utils/flight.py), wired in start()
         self.flight_recorder = None
         self.watchdog = None
+
+        # cluster telemetry plane (rpc/telemetry_digest.py): local digest
+        # collection piggybacked on the status gossip + S3 SLO budgets
+        from ..rpc.telemetry_digest import DigestCollector, SloTracker
+
+        self.telemetry = DigestCollector(self)
+        self.system.telemetry_collector = self.telemetry.collect
+        self.slo_tracker = SloTracker(
+            availability_target=config.admin.slo_availability_target,
+            latency_target_msec=config.admin.slo_latency_p99_target_msec,
+            window_secs=config.admin.slo_window_secs,
+        )
         self._started = False
 
     def ec_layout_warning(self, lv) -> str | None:
@@ -386,6 +398,9 @@ class Garage:
             )
             self.watchdog.start()
         self._register_gauges()
+        # uptime measures SERVING time: restamp at start(), not object
+        # construction (recovery work can run between the two)
+        self.telemetry.started_at = self.telemetry.clock()
         self._started = True
 
     def _register_gauges(self) -> None:
@@ -420,6 +435,18 @@ class Garage:
             "cluster_connected_nodes", (),
             lambda: len(self.system.peering.connected_peers()),
         )
+        # SLO error budgets (rpc/telemetry_digest.py SloTracker), scrape-
+        # time so the rolling window advances even without digest traffic
+        for kind in ("availability", "latency_p99"):
+            lbl = (("slo", kind),)
+            reg(
+                "slo_error_budget_remaining", lbl,
+                lambda k=kind: self.slo_tracker.compute()[k]["budget_remaining"],
+            )
+            reg(
+                "slo_burn_rate", lbl,
+                lambda k=kind: self.slo_tracker.compute()[k]["burn_rate"],
+            )
 
     def spawn_workers(self) -> None:
         for t in self.tables:

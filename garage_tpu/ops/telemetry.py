@@ -56,6 +56,13 @@ def resolved_platform(pin: str | None = None) -> str:
         return "unknown"
 
 
+def platforms_seen() -> list[str]:
+    """Backends that have actually served a dispatch in this process
+    (the label set behind the `jax_backend_platform` gauge) — consumed
+    by the cluster telemetry digest (rpc/telemetry_digest.py)."""
+    return sorted(_platforms_seen)
+
+
 def note_platform(platform: str) -> None:
     """Register the scrape-time backend gauge once per resolved platform
     (labels are fixed at registration, so the platform must already be

@@ -14,7 +14,7 @@ import logging
 import shutil
 import socket
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any
 
 from ..net.message import PRIO_HIGH, Req, Resp
@@ -33,6 +33,10 @@ logger = logging.getLogger("garage.system")
 STATUS_EXCHANGE_INTERVAL = 10.0
 DISCOVERY_INTERVAL = 60.0
 ADVERTISE_COALESCE = 0.2  # burst-coalescing window for layout gossip
+# node_status entries older than this are aged out (a dead peer stops
+# refreshing; keeping it forever made the rollup and `garage status`
+# show departed nodes as current indefinitely)
+NODE_STATUS_EXPIRY = 6 * STATUS_EXCHANGE_INTERVAL
 
 
 @dataclass
@@ -43,9 +47,13 @@ class NodeStatus:
     meta_disk_avail: tuple[int, int] | None = None  # (free, total)
     data_disk_avail: tuple[int, int] | None = None
     replication_factor: int = 1
+    # cluster telemetry plane (rpc/telemetry_digest.py): the sender's
+    # pre-aggregated telemetry digest, piggybacked on the status
+    # exchange.  None from peers running a version without the field.
+    telemetry: Any = None
 
     def to_obj(self) -> Any:
-        return {
+        obj = {
             "h": self.hostname,
             "v": self.version,
             "ld": self.layout_digest,
@@ -53,6 +61,9 @@ class NodeStatus:
             "dd": list(self.data_disk_avail) if self.data_disk_avail else None,
             "rf": self.replication_factor,
         }
+        if self.telemetry is not None:
+            obj["tm"] = self.telemetry
+        return obj
 
     @classmethod
     def from_obj(cls, obj: Any) -> "NodeStatus":
@@ -63,6 +74,7 @@ class NodeStatus:
             meta_disk_avail=tuple(obj["md"]) if obj.get("md") else None,
             data_disk_avail=tuple(obj["dd"]) if obj.get("dd") else None,
             replication_factor=obj.get("rf", 1),
+            telemetry=obj.get("tm"),  # tolerant: old peers don't send it
         )
 
 
@@ -76,6 +88,9 @@ class ClusterHealth:
     partitions: int = N_PARTITIONS
     partitions_quorum: int = 0
     partitions_all_ok: int = 0
+    # MAD-flagged sick nodes (rpc/telemetry_digest.py detect_outliers);
+    # empty when fewer than 3 nodes report digests
+    outlier_nodes: list[str] = field(default_factory=list)
 
 
 class PersistedPeers(Migratable):
@@ -123,6 +138,11 @@ class System:
             known.extend(persisted.peers)
         self.peering = PeeringManager(netapp, known, public_addr=public_addr)
         self.node_status: dict[bytes, tuple[NodeStatus, float]] = {}
+        # cluster telemetry plane: model/garage.py points this at its
+        # DigestCollector.collect so every outgoing NodeStatus carries
+        # the local digest (None = no collector, e.g. bare System tests)
+        self.telemetry_collector = None
+        self.status_expiry = NODE_STATUS_EXPIRY
         self._tasks: list[asyncio.Task] = []
         # coalesced layout gossip state (see _advertise_loop)
         self._adv_event = asyncio.Event()
@@ -172,6 +192,12 @@ class System:
             except OSError:
                 return None
 
+        telemetry = None
+        if self.telemetry_collector is not None:
+            try:
+                telemetry = self.telemetry_collector()
+            except Exception:  # noqa: BLE001 — status gossip must survive
+                logger.exception("telemetry digest collection failed")
         return NodeStatus(
             hostname=socket.gethostname(),
             version="garage-tpu/0.1.0",
@@ -179,6 +205,7 @@ class System:
             meta_disk_avail=disk(self.metadata_dir) if self.metadata_dir else None,
             data_disk_avail=disk(self.data_dirs[0]) if self.data_dirs else None,
             replication_factor=self.replication_mode.replication_factor,
+            telemetry=telemetry,
         )
 
     async def _handle_status(self, from_id: bytes, req: Req) -> Resp:
@@ -269,24 +296,52 @@ class System:
 
     # --- loops ---------------------------------------------------------------
 
+    async def status_exchange_once(self) -> None:
+        """One status-gossip wave: push our NodeStatus (+ telemetry
+        digest) to every connected peer, record theirs, age out entries
+        from departed peers.  The status loop's body; tests drive it
+        directly to converge a cluster without waiting out the
+        exchange interval."""
+        st = self.local_status().to_obj()
+
+        async def exchange(pid):
+            try:
+                resp = await self.status_ep.call(
+                    pid, st, prio=PRIO_HIGH, timeout=10.0
+                )
+                self._record_status(pid, NodeStatus.from_obj(resp.body))
+            except Exception:  # noqa: BLE001
+                pass
+
+        # concurrent fan-out: one hung peer must not delay the rest
+        await asyncio.gather(
+            *[exchange(pid) for pid in self.peering.connected_peers()]
+        )
+        self.expire_node_status()
+
+    def expire_node_status(self) -> None:
+        """Age out status entries no longer being refreshed.  A
+        connected peer re-records every exchange; an entry that is BOTH
+        stale and disconnected belongs to a departed node — dropping it
+        removes the node from the telemetry rollup and from `garage
+        status` hostnames.  (Digest rows are rendered inline from this
+        map, never registered as per-node gauges, so there is nothing
+        else to unregister.)"""
+        now = time.monotonic()
+        for pid in [
+            p
+            for p, (_st, ts) in self.node_status.items()
+            if now - ts > self.status_expiry and not self.netapp.is_connected(p)
+        ]:
+            logger.info(
+                "aging out status of departed node %s", pid.hex()[:8]
+            )
+            del self.node_status[pid]
+
     async def _status_loop(self) -> None:
         while True:
             try:
-                st = self.local_status().to_obj()
-
-                async def exchange(pid):
-                    try:
-                        resp = await self.status_ep.call(
-                            pid, st, prio=PRIO_HIGH, timeout=10.0
-                        )
-                        self._record_status(pid, NodeStatus.from_obj(resp.body))
-                    except Exception:  # noqa: BLE001
-                        pass
-
-                # concurrent fan-out: one hung peer must not delay the rest
-                await asyncio.gather(
-                    *[exchange(pid) for pid in self.peering.connected_peers()]
-                )
+                await self.status_exchange_once()
             except Exception:  # noqa: BLE001
                 logger.exception("status loop error")
             await asyncio.sleep(STATUS_EXCHANGE_INTERVAL)
@@ -340,7 +395,10 @@ class System:
 
     # --- health --------------------------------------------------------------
 
-    def health(self) -> ClusterHealth:
+    def health(self, outlier_nodes: list[str] | None = None) -> ClusterHealth:
+        """`outlier_nodes`: pass a precomputed set (telemetry rollup /
+        federated exposition already ran the MAD detector on the same
+        rows) to avoid re-deriving it; None computes it here."""
         layout = self.layout_manager.history
         storage_nodes = layout.all_storage_nodes()
         up = {
@@ -372,6 +430,10 @@ class System:
             elif n_all < N_PARTITIONS or len(up) < len(storage_nodes):
                 status = "degraded"
         known = self.peering.peers
+        if outlier_nodes is None:
+            from .telemetry_digest import outlier_node_ids
+
+            outlier_nodes = outlier_node_ids(self)
         return ClusterHealth(
             status=status,
             known_nodes=len(known) + 1,
@@ -380,4 +442,5 @@ class System:
             storage_nodes_up=len(up),
             partitions_quorum=n_quorum,
             partitions_all_ok=n_all,
+            outlier_nodes=outlier_nodes,
         )

@@ -65,6 +65,14 @@ class AdminConfig:
     # event-loop watchdog: scheduling-lag histogram + blocked-loop task
     # dumps; 0 disables
     event_loop_watchdog_threshold_msec: float = 250.0
+    # SLO tracker (rpc/telemetry_digest.py SloTracker): S3 availability
+    # target (percent of requests answered without a 5xx) and p99
+    # latency target, both accounted over a rolling window -> the
+    # `slo_error_budget_remaining` / `slo_burn_rate` gauges and the
+    # cluster rollup's SLO block
+    slo_availability_target: float = 99.9  # percent
+    slo_latency_p99_target_msec: float = 1000.0
+    slo_window_secs: float = 3600.0
 
 
 @dataclass
@@ -404,6 +412,19 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             f"invalid metadata_fsync {cfg.metadata_fsync!r}: accepted values "
             'are true, false, or "group" (group commit, native engine only)'
         )
+    # SLO knobs: a target of 100.0 would make the allowed-error fraction
+    # zero (every request burns infinite budget) — refuse the footgun at
+    # load time along with plainly-invalid values
+    if not (0.0 < float(cfg.admin.slo_availability_target) < 100.0):
+        raise ValueError(
+            f"invalid slo_availability_target "
+            f"{cfg.admin.slo_availability_target!r}: want a percentage in "
+            "(0, 100), e.g. 99.9"
+        )
+    if float(cfg.admin.slo_latency_p99_target_msec) <= 0:
+        raise ValueError("slo_latency_p99_target_msec must be > 0")
+    if float(cfg.admin.slo_window_secs) <= 0:
+        raise ValueError("slo_window_secs must be > 0")
     # resolve secrets
     cfg.rpc_secret = _get_secret(
         cfg.rpc_secret,

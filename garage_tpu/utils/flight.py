@@ -40,7 +40,7 @@ import sys
 import threading
 import time
 
-from .metrics import BUCKETS, registry
+from .metrics import registry
 
 logger = logging.getLogger("garage.flight")
 
@@ -288,9 +288,6 @@ class EventLoopWatchdog:
         self._last_beat = 0.0
         self._expected = 0.0
         self._last_dump = 0.0
-        # declared before the first observe so the family renders with
-        # standard histogram exposition (`_sum`, not `_seconds_total`)
-        registry.set_buckets("event_loop_lag_seconds", BUCKETS)
 
     def start(self, loop=None) -> None:
         self._loop = loop or asyncio.get_event_loop()

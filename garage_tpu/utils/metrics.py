@@ -9,12 +9,12 @@ admin API, no external deps:
   - latency histograms        observe()/timer() — log2-spaced buckets from
                               0.25 ms to ~8 s plus +Inf, so p99 is visible
                               (BASELINE's S3 target is a p99), rendered in
-                              standard `_bucket{le=…}` form
+                              standard `_bucket{le=…}`/`_count`/`_sum` form
+                              (`_sum` in seconds)
   - value histograms          set_buckets(name, SIZE_BUCKETS) declares a
                               family whose observations are plain values
                               (batch sizes, byte counts), bucketed on its
-                              own scheme and rendered with a `_sum` line
-                              instead of `_seconds_total`
+                              own scheme; `_sum` is in the family's unit
   - gauges                    set_gauge() for pushed values, or
                               register_gauge(name, labels, fn) for values
                               polled at scrape time (queue lengths,
@@ -89,6 +89,80 @@ class Metrics:
         self._gauge_fns.pop((name, labels), None)
         self.gauges.pop((name, labels), None)
 
+    # --- family aggregation (cluster telemetry digest, SLO tracker) ----------
+
+    def counter_family_sum(self, name: str, pred=None) -> float:
+        """Sum a counter family across every label set (optionally only
+        those where `pred(labels_tuple)` holds) — e.g. total S3 requests
+        regardless of method."""
+        return sum(
+            v
+            for (n, labels), v in self.counters.items()
+            if n == name and (pred is None or pred(labels))
+        )
+
+    def gauge_family_sum(self, name: str) -> float:
+        """Sum a gauge family across label sets, calling registered
+        scrape-time fns (a failing fn contributes 0, like render())."""
+        total = sum(v for (n, _l), v in self.gauges.items() if n == name)
+        for (n, _l), fn in list(self._gauge_fns.items()):
+            if n != name:
+                continue
+            try:
+                total += float(fn())
+            except Exception:  # noqa: BLE001
+                continue
+        return total
+
+    def family_merge(self, name: str) -> tuple[int, float, list[int]] | None:
+        """Merge a histogram family across all its label sets into one
+        (count, sum, per-bucket counts) triple — the cluster digest wants
+        ONE p99 for `api_s3_request_duration`, not one per method."""
+        merged: list | None = None
+        for (n, _labels), (cnt, total, buckets) in self.durations.items():
+            if n != name:
+                continue
+            if merged is None:
+                merged = [0, 0.0, [0] * len(buckets)]
+            merged[0] += cnt
+            merged[1] += total
+            for i, c in enumerate(buckets):
+                merged[2][i] += c
+        return None if merged is None else (merged[0], merged[1], merged[2])
+
+    def family_quantile(self, name: str, q: float) -> float | None:
+        """Approximate quantile over the MERGED family histogram."""
+        m = self.family_merge(name)
+        if m is None or m[0] == 0:
+            return None
+        bs = self._family_buckets.get(name, BUCKETS)
+        target = q * m[0]
+        acc = 0
+        for i, c in enumerate(m[2]):
+            acc += c
+            if acc >= target:
+                return bs[i] if i < len(bs) else float("inf")
+        return float("inf")
+
+    def family_count_over(self, name: str, threshold: float) -> tuple[int, int]:
+        """(total observations, observations ABOVE `threshold`) for a
+        merged histogram family.  The threshold snaps to the NEAREST
+        bucket bound: with log2 buckets a 1000 ms target evaluates at
+        1024 ms — the alternative (largest bound <= threshold, 512 ms)
+        would score all healthy 600-900 ms traffic as over-target and
+        blow the latency SLO budget for a met SLO.  The latency-SLO
+        tracker's "requests slower than the p99 target" feed."""
+        m = self.family_merge(name)
+        if m is None:
+            return (0, 0)
+        bs = self._family_buckets.get(name, BUCKETS)
+        cutoff = min(bs, key=lambda b: abs(b - threshold))
+        under = 0
+        for i, c in enumerate(m[2][:-1]):
+            if bs[i] <= cutoff:
+                under += c
+        return (m[0], m[0] - under)
+
     def quantile(self, name: str, labels: tuple, q: float) -> float | None:
         """Approximate quantile from the histogram (upper bucket bound)."""
         d = self.durations.get((name, labels))
@@ -128,11 +202,15 @@ class Metrics:
                 lines.append(f"{name}_bucket{_fmt(labels + le)} {acc}")
             lines.append(f'{name}_bucket{_fmt(labels + (("le", "+Inf"),))} {n}')
             lines.append(f"{name}_count{_fmt(labels)} {n}")
+            # Prometheus-standard `_sum` for every histogram (latency
+            # families used to render a nonstandard `_seconds_total`,
+            # which histogram_quantile-adjacent recording rules and
+            # `rate(x_sum)/rate(x_count)` averages can't use)
             if name in self._family_buckets:
                 # value histogram: the sum is in the family's own unit
                 lines.append(f"{name}_sum{_fmt(labels)} {total:g}")
             else:
-                lines.append(f"{name}_seconds_total{_fmt(labels)} {total:.6f}")
+                lines.append(f"{name}_sum{_fmt(labels)} {total:.6f}")
         gauges = dict(self.gauges)
         for (name, labels), fn in self._gauge_fns.items():
             try:

@@ -1,0 +1,104 @@
+"""Satellite (ISSUE 5): dashboards must not silently rot.
+
+`script/dashboard_lint.py` cross-checks every metric family referenced
+by the Grafana dashboard against a LIVE node's scrape (`/metrics` +
+`/metrics/cluster`) plus the doc/monitoring.md catalogue — run here as
+a tier-1 test so renaming a family without updating the dashboard or
+the doc fails CI."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "script")
+)
+
+from dashboard_lint import (
+    DASHBOARD,
+    DOC,
+    families_in_dashboard,
+    families_in_doc,
+    families_in_exposition,
+    lint,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_dashboard_families_extracted():
+    fams = families_in_dashboard(DASHBOARD)
+    # sanity: extraction sees both plain gauges and histogram families
+    assert "cluster_healthy" in fams
+    assert "api_s3_request_duration" in fams  # _bucket suffix stripped
+    assert "slo_error_budget_remaining" in fams  # the new SLO row
+    assert "cluster_node_outlier" in fams  # federated row
+    # PromQL noise is filtered
+    assert "histogram_quantile" not in fams
+    assert "rate" not in fams
+
+
+def test_doc_catalogue_extracted():
+    doc = families_in_doc(DOC)
+    assert "repair_plan_backlog" in doc
+    assert "tpu_mesh_engaged_total" in doc
+    # families inside the cluster-telemetry section (after a ``` fence —
+    # regression guard for the backtick-pairing bug)
+    assert "cluster_node_s3_p99_seconds" in doc
+    assert "slo_burn_rate" in doc
+
+
+def test_lint_flags_unknown_family():
+    errs = lint({"made_up_family_total": ["Some panel"]},
+                families_in_doc(DOC), set())
+    assert len(errs) == 1 and "made_up_family_total" in errs[0]
+
+
+def test_dashboard_lint_against_live_node(tmp_path):
+    """The shipped dashboard passes against a live scrape + catalogue."""
+    import aiohttp
+
+    from test_s3_api import make_client, make_daemon, teardown
+
+    from garage_tpu.api.admin.api_server import AdminApiServer
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        adm = AdminApiServer(garage)
+        await adm.start("127.0.0.1", 0)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("lintb")
+            await client.put_object("lintb", "k", b"q" * 8_000)
+            await client.get_object("lintb", "k")
+            await asyncio.sleep(0.3)  # workers + watchdog families
+
+            scraped = set()
+            base = f"http://127.0.0.1:{adm.runner.addresses[0][1]}"
+            async with aiohttp.ClientSession() as sess:
+                for ep in ("/metrics", "/metrics/cluster"):
+                    async with sess.get(base + ep) as r:
+                        assert r.status == 200
+                        scraped |= families_in_exposition(await r.text())
+
+            errs = lint(
+                families_in_dashboard(DASHBOARD),
+                families_in_doc(DOC),
+                scraped,
+            )
+            assert not errs, errs
+            # the live scrape alone already covers most of the dashboard
+            # (doc-only families are the load-gated ones: repair plan,
+            # mesh engagement, ...)
+            live_only = {
+                f for f in families_in_dashboard(DASHBOARD) if f in scraped
+            }
+            assert len(live_only) >= 20, sorted(live_only)
+        finally:
+            await adm.stop()
+            await teardown(garage, s3)
+
+    run(main())

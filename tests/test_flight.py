@@ -323,9 +323,17 @@ def test_worker_and_debug_cli_paths(tmp_path):
             )
             assert garage.block_manager.resync.tranquility == 4
 
+            # stats: human table by default (folds in the local
+            # telemetry digest), raw JSON with --json
             out = await dispatch(ns(cmd="stats"), call, garage.config)
+            assert "==== TABLES ====" in out and "object" in out
+            assert "TELEMETRY" in out and "s3 req/s" in out
+            out = await dispatch(
+                SimpleNamespace(json=True, cmd="stats"), call, garage.config
+            )
             st = json.loads(out)
             assert "tables" in st and "blocks" in st
+            assert st["telemetry"]["v"] == 1
 
             out = await dispatch(
                 ns(cmd="debug", debug_cmd="profile", seconds=0.3, hz=50,

@@ -7,6 +7,9 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "script")
+)
 
 from garage_tpu.utils.metrics import BUCKETS, Metrics
 from garage_tpu.utils.tracing import Tracer
@@ -69,6 +72,10 @@ def test_daemon_metrics_endpoint_has_gauges_and_histograms(tmp_path):
             assert "block_resync_queue_length" in text
             assert "table_merkle_updater_todo_queue_length" in text
             assert 'api_s3_request_duration_bucket' in text
+            # latency histograms render the Prometheus-standard `_sum`
+            # (in seconds), not the old `_seconds_total`
+            assert 'api_s3_request_duration_sum{method=' in text
+            assert "_seconds_total" not in text
             assert 'le="+Inf"' in text
             assert "cluster_connected_nodes 0" in text
             # per-endpoint rpc + per-table op families (reference
@@ -92,15 +99,12 @@ def test_metrics_exposition_lint(tmp_path):
     no family is declared twice (the old inline/registry duplication of
     the resync/merkle/gc queue gauges), no duplicate (name, labelset)
     pairs, and the bare `worker_errors` gauge is gone in favour of the
-    registry-backed `worker_*` families."""
-    import re
-
+    registry-backed `worker_*` families.  The strict parser itself is
+    the shared script/dashboard_lint.py lint_exposition."""
+    from dashboard_lint import lint_exposition
     from test_s3_api import make_client, make_daemon, teardown
 
     from garage_tpu.api.admin.api_server import AdminApiServer
-
-    NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-    SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s(\S+)$")
 
     async def main():
         garage, s3, endpoint = await make_daemon(tmp_path)
@@ -121,42 +125,11 @@ def test_metrics_exposition_lint(tmp_path):
                     assert r.status == 200
                     text = await r.text()
 
-            types: dict[str, str] = {}
-            seen_samples: set[tuple[str, str]] = set()
-            samples_started: set[str] = set()
-            for lineno, line in enumerate(text.splitlines(), 1):
-                if not line.strip():
-                    continue
-                if line.startswith("# TYPE "):
-                    _, _, rest = line.partition("# TYPE ")
-                    fam, typ = rest.rsplit(" ", 1)
-                    assert NAME_RE.match(fam), line
-                    assert typ in ("counter", "gauge", "histogram"), line
-                    assert fam not in types, f"family {fam} declared twice"
-                    assert fam not in samples_started, (
-                        f"TYPE for {fam} after its samples"
-                    )
-                    types[fam] = typ
-                    continue
-                if line.startswith("#"):
-                    continue
-                m = SAMPLE_RE.match(line)
-                assert m, f"line {lineno} unparseable: {line!r}"
-                name, labels, value = m.group(1), m.group(2) or "", m.group(3)
-                float(value)  # parses as a number
-                key = (name, labels)
-                assert key not in seen_samples, f"duplicate sample {key}"
-                seen_samples.add(key)
-                # resolve the family: exact name, else histogram suffixes
-                fam = name if name in types else None
-                if fam is None:
-                    for suf in ("_bucket", "_count", "_sum", "_seconds_total"):
-                        base = name.removesuffix(suf)
-                        if base != name and types.get(base) == "histogram":
-                            fam = base
-                            break
-                assert fam is not None, f"sample {name} has no TYPE family"
-                samples_started.add(fam)
+            types = lint_exposition(text)  # raises on format violations
+            # standard histogram exposition ONLY: the nonstandard
+            # `_seconds_total` suffix latency families used to render
+            # is gone in favour of `_sum` (in seconds)
+            assert "_seconds_total" not in text
 
             # the formerly-duplicated families exist exactly once, from
             # the registry
