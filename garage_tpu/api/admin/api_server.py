@@ -273,6 +273,14 @@ class AdminApiServer:
                 headers={"x-garage-profile-samples": str(prof.samples)},
             )
 
+        if path == "/v1/debug/latency" and request.method == "GET":
+            # latency X-ray (utils/latency.py): rolling per-op phase
+            # waterfall — p50/p95/p99 per phase, critical-path share,
+            # coverage, overlap efficiency
+            from ...utils.latency import latency_response
+
+            return web.json_response(latency_response())
+
         if path == "/v1/debug/slow" and request.method == "GET":
             # flight recorder: span trees of the slowest recent requests
             from ...utils import flight

@@ -179,8 +179,11 @@ class S3ApiServer:
             if bucket_name and not key:
                 return await handle_post_object(self, bucket_name, request)
 
-        ctx = await verify_request(request, self._get_secret, self.region)
-        api_key: Key = await self.garage.helper.get_key(ctx.key_id)
+        from ...utils.latency import phase_span
+
+        with phase_span("auth"):
+            ctx = await verify_request(request, self._get_secret, self.region)
+            api_key: Key = await self.garage.helper.get_key(ctx.key_id)
         bucket_name, key = self._parse_target(request)
         method = request.method
 
@@ -205,7 +208,10 @@ class S3ApiServer:
         ):
             return await self._create_bucket(bucket_name, api_key, request, ctx)
 
-        bucket_id = await self.garage.helper.resolve_bucket(bucket_name, api_key)
+        with phase_span("index_read"):
+            bucket_id = await self.garage.helper.resolve_bucket(
+                bucket_name, api_key
+            )
         perm = api_key.bucket_permissions(bucket_id)
         q = request.query
 

@@ -21,6 +21,7 @@ from ...model.s3.mpu_table import MultipartUpload
 from ...model.s3.object_table import Object, ObjectVersion
 from ...model.s3.version_table import Version
 from ...utils.data import blake2sum, gen_uuid
+from ...utils.latency import mark_op, phase_span
 from ...utils.time_util import now_msec
 from ..common.error import ApiError, BadRequest, NoSuchKey, NoSuchUpload
 from .objects import PUT_BLOCKS_MAX_PARALLEL, _check_sha256, extract_meta_headers
@@ -69,11 +70,13 @@ async def _get_mpu(garage, bucket_id, key, upload_id_hex) -> MultipartUpload:
 
 
 async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
+    mark_op("upload_part")
     q = request.query
     part_number = int(q.get("partNumber", "0"))
     if not (1 <= part_number <= 10000):
         raise BadRequest("partNumber must be in 1..10000")
-    mpu = await _get_mpu(garage, bucket_id, key, q.get("uploadId", ""))
+    with phase_span("index_read"):
+        mpu = await _get_mpu(garage, bucket_id, key, q.get("uploadId", ""))
 
     from ..common.checksum import ChecksumRequest
     from .encryption import EncryptionParams, check_match
@@ -83,7 +86,8 @@ async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
     cks = ChecksumRequest.from_headers(request.headers)
 
     vid = gen_uuid()  # this part's own version
-    await garage.version_table.insert(Version(vid, bucket_id, key))
+    with phase_span("meta_commit"):
+        await garage.version_table.insert(Version(vid, bucket_id, key))
     from .objects import stream_blocks
 
     try:
@@ -104,7 +108,8 @@ async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
     etag = md5_hex
     upd = MultipartUpload(mpu.upload_id, bucket_id, key, timestamp=mpu.timestamp)
     upd.parts.put([part_number, now_msec()], {"vid": vid, "etag": etag, "s": total})
-    await garage.mpu_table.insert(upd)
+    with phase_span("meta_commit"):
+        await garage.mpu_table.insert(upd)
     return web.Response(status=200, headers={"ETag": f'"{etag}"'})
 
 

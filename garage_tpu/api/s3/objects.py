@@ -27,6 +27,7 @@ from ...model.s3.block_ref_table import BlockRef
 from ...model.s3.object_table import Object, ObjectVersion
 from ...model.s3.version_table import Version
 from ...utils.data import blake2sum, gen_uuid
+from ...utils.latency import mark_op, phase_span
 from ...utils.time_util import now_msec
 from ..common.error import (
     ApiError,
@@ -144,13 +145,17 @@ async def stream_blocks(
     inflight: set[asyncio.Task] = set()
 
     async def put_one(block: bytes, block_offset: int):
-        stored = transform(block) if transform else block
-        h = blake2sum(stored)
+        with phase_span("hash"):
+            stored = transform(block) if transform else block
+            h = blake2sum(stored)
         await garage.block_manager.rpc_put_block(h, stored)
-        v = Version(vid, bucket_id, key)
-        v.blocks.put([part_number, block_offset], {"h": h, "s": len(stored)})
-        await garage.version_table.insert(v)
-        await garage.block_ref_table.insert(BlockRef(h, vid))
+        with phase_span("meta_commit"):
+            v = Version(vid, bucket_id, key)
+            v.blocks.put(
+                [part_number, block_offset], {"h": h, "s": len(stored)}
+            )
+            await garage.version_table.insert(v)
+            await garage.block_ref_table.insert(BlockRef(h, vid))
 
     async def launch(block: bytes, block_offset: int):
         # backpressure: at most PUT_BLOCKS_MAX_PARALLEL blocks buffered in
@@ -169,22 +174,25 @@ async def stream_blocks(
         while True:
             while len(buf) >= block_size:
                 block, buf = buf[:block_size], buf[block_size:]
-                md5.update(block)
-                sha.update(block)
-                if extra_hash is not None:
-                    extra_hash.update(block)
+                with phase_span("hash"):
+                    md5.update(block)
+                    sha.update(block)
+                    if extra_hash is not None:
+                        extra_hash.update(block)
                 await launch(block, offset)
                 offset += len(block)
                 total += len(block)
-            chunk = await body.read(block_size)
+            with phase_span("chunk"):
+                chunk = await body.read(block_size)
             if not chunk:
                 break
             buf += chunk
         if buf:
-            md5.update(buf)
-            sha.update(buf)
-            if extra_hash is not None:
-                extra_hash.update(buf)
+            with phase_span("hash"):
+                md5.update(buf)
+                sha.update(buf)
+                if extra_hash is not None:
+                    extra_hash.update(buf)
             await launch(buf, offset)
             total += len(buf)
         if inflight:
@@ -202,20 +210,27 @@ async def handle_put_object(
     from ..common.checksum import ChecksumRequest
     from .encryption import EncryptionParams
 
+    mark_op("put")
     enc = EncryptionParams.from_headers(request.headers)
     cks = ChecksumRequest.from_headers(request.headers)
     headers = extract_meta_headers(request)
     body = request.content
     block_size = garage.config.block_size
-    existing = await garage.object_table.get(bucket_id, key.encode())
+    with phase_span("index_read"):
+        existing = await garage.object_table.get(bucket_id, key.encode())
     ts = next_timestamp(existing)
 
-    first = await _read_at_least(body, INLINE_THRESHOLD + 1)
+    with phase_span("chunk"):
+        first = await _read_at_least(body, INLINE_THRESHOLD + 1)
     if len(first) <= INLINE_THRESHOLD:
         # inline object
-        sha = hashlib.sha256(first)
+        with phase_span("hash"):
+            sha = hashlib.sha256(first)
         _check_sha256(ctx, sha)
-        await check_quotas(garage, bucket_id, key, len(first), existing=existing)
+        with phase_span("index_read"):
+            await check_quotas(
+                garage, bucket_id, key, len(first), existing=existing
+            )
         etag = hashlib.md5(first).hexdigest()
         meta = {"size": len(first), "etag": etag, "headers": headers}
         if cks is not None:
@@ -233,7 +248,10 @@ async def handle_put_object(
             "complete",
             {"t": "inline", "bytes": stored, "meta": meta},
         )
-        await garage.object_table.insert(Object(bucket_id, key, [version]))
+        with phase_span("meta_commit"):
+            await garage.object_table.insert(
+                Object(bucket_id, key, [version])
+            )
         resp_headers = {"ETag": f'"{etag}"'}
         if enc is not None:
             resp_headers.update(enc.response_headers())
@@ -242,8 +260,9 @@ async def handle_put_object(
     # multi-block object
     vid = gen_uuid()
     version0 = ObjectVersion(vid, ts, "uploading", {"t": "first_block", "vid": vid})
-    await garage.object_table.insert(Object(bucket_id, key, [version0]))
-    await garage.version_table.insert(Version(vid, bucket_id, key))
+    with phase_span("meta_commit"):
+        await garage.object_table.insert(Object(bucket_id, key, [version0]))
+        await garage.version_table.insert(Version(vid, bucket_id, key))
     buf_first = first
 
     try:
@@ -254,7 +273,10 @@ async def handle_put_object(
         _check_sha256(ctx, sha)
         if cks is not None and cks.expected_b64 is None:
             cks.resolve_trailer(getattr(body, "trailers", {}) or {})
-        await check_quotas(garage, bucket_id, key, total, existing=existing)
+        with phase_span("index_read"):
+            await check_quotas(
+                garage, bucket_id, key, total, existing=existing
+            )
 
         etag = md5_hex
         meta = {"size": total, "etag": etag, "headers": headers}
@@ -266,7 +288,8 @@ async def handle_put_object(
             vid, ts, "complete",
             {"t": "first_block", "vid": vid, "meta": meta},
         )
-        await garage.object_table.insert(Object(bucket_id, key, [final]))
+        with phase_span("meta_commit"):
+            await garage.object_table.insert(Object(bucket_id, key, [final]))
         resp_headers = {"ETag": f'"{etag}"'}
         if enc is not None:
             resp_headers.update(enc.response_headers())
@@ -522,7 +545,9 @@ async def handle_get_object(
 ) -> web.StreamResponse:
     from .encryption import EncryptionParams, check_match
 
-    obj = await garage.object_table.get(bucket_id, key.encode())
+    mark_op("head" if head_only else "get")
+    with phase_span("index_read"):
+        obj = await garage.object_table.get(bucket_id, key.encode())
     version = _pick_version(obj)
     _check_conditionals(request, version)
     meta = version.data.get("meta", {})
@@ -554,7 +579,8 @@ async def handle_get_object(
     # plain HEAD never needs the block list — don't pay a version-table
     # quorum read on that hot path
     if not is_inline and (part_number is not None or not head_only):
-        ver = await garage.version_table.get(version.data["vid"], b"")
+        with phase_span("index_read"):
+            ver = await garage.version_table.get(version.data["vid"], b"")
         if ver is None or ver.deleted.get():
             raise NoSuchKey("version data missing")
         blocks = ver.sorted_blocks()
@@ -603,7 +629,8 @@ async def handle_get_object(
         async for chunk in plain_block_stream(
             garage, blocks, start, end, enc_params
         ):
-            await resp.write(chunk)
+            with phase_span("stream_out"):
+                await resp.write(chunk)
     except Exception as e:  # noqa: BLE001
         # 200 + Content-Length are already on the wire, so an error
         # document can no longer be sent — abort the connection so the
@@ -621,12 +648,15 @@ async def handle_get_object(
 
 
 async def handle_delete_object(garage, bucket_id: bytes, key: str) -> web.Response:
-    obj = await garage.object_table.get(bucket_id, key.encode())
+    mark_op("delete")
+    with phase_span("index_read"):
+        obj = await garage.object_table.get(bucket_id, key.encode())
     if obj is None or obj.last_visible() is None:
         # deleting a non-existent object is a success in S3
         return web.Response(status=204)
     dm = ObjectVersion(
         gen_uuid(), next_timestamp(obj), "complete", {"t": "delete_marker"}
     )
-    await garage.object_table.insert(Object(bucket_id, key, [dm]))
+    with phase_span("meta_commit"):
+        await garage.object_table.insert(Object(bucket_id, key, [dm]))
     return web.Response(status=204)

@@ -467,21 +467,26 @@ class BlockManager:
 
     async def _rpc_put_block(self, hash32: bytes, data: bytes) -> None:
         from ..net.stream import bytes_stream
+        from ..utils.latency import phase_span
 
         layout = self.system.layout_manager.history
         write_sets = layout.write_sets_of(hash32)
         quorum = self.system.replication_mode.write_quorum()
         if self.codec.n_pieces == 1:
-            stored, compressed = self._maybe_compress(data)
+            with phase_span("encode"):
+                stored, compressed = self._maybe_compress(data)
             async with self.buffers.reserve(len(stored)):
-                await self.helper.try_write_many_sets(
-                    self.endpoint,
-                    write_sets,
-                    ["Put", hash32, {"c": compressed, "s": len(stored)}],
-                    quorum=quorum,
-                    prio=PRIO_NORMAL,
-                    stream_factory=lambda: bytes_stream(stored),
-                )
+                # replica sends + their quorum wait are one awaited call;
+                # the whole window is attributed to the fan-out phase
+                with phase_span("fanout"):
+                    await self.helper.try_write_many_sets(
+                        self.endpoint,
+                        write_sets,
+                        ["Put", hash32, {"c": compressed, "s": len(stored)}],
+                        quorum=quorum,
+                        prio=PRIO_NORMAL,
+                        stream_factory=lambda: bytes_stream(stored),
+                    )
             return
         # EC: one distinct piece per node rank, placed in EVERY active
         # layout version (the EC analog of try_write_many_sets, reference
@@ -496,7 +501,8 @@ class BlockManager:
         # heal via resync anyway).  Waiting for ALL k+m sends made the EC
         # PUT p99 the max over k+m nodes vs the replica path's
         # quorum-of-RF, measurably fattening the tail (bench_s3.py).
-        pieces = self.codec.encode(data)
+        with phase_span("encode"):
+            pieces = self.codec.encode(data)
         send_targets, per_version = self._ec_piece_targets(hash32, layout)
         # quorum counts DISTINCT pieces stored per layout version; tolerate
         # up to half the parity pieces missing (resync rebuilds them) — but
@@ -524,18 +530,24 @@ class BlockManager:
 
         async def one(n: bytes, i: int) -> None:
             try:
-                await self.helper.call(
-                    self.endpoint, n,
-                    ["Put", hash32,
-                     {"c": False, "p": i, "l": len(data),
-                      "s": len(pieces[i])}],
-                    prio=PRIO_NORMAL,
-                    # same deadline as the caller's quorum wait below — a
-                    # longer per-send default would abort slow-but-alive
-                    # sends as "quorum failure" with an empty error list
-                    timeout=self.helper.default_timeout,
-                    stream_factory=lambda i=i: bytes_stream(pieces[i]),
-                )
+                # per-send phase spans run in the sender task but share
+                # the caller's trace (context captured at spawn); the
+                # analyzer merges the parallel windows into one wall-
+                # clock fan-out interval
+                with phase_span("fanout"):
+                    await self.helper.call(
+                        self.endpoint, n,
+                        ["Put", hash32,
+                         {"c": False, "p": i, "l": len(data),
+                          "s": len(pieces[i])}],
+                        prio=PRIO_NORMAL,
+                        # same deadline as the caller's quorum wait below
+                        # — a longer per-send default would abort slow-
+                        # but-alive sends as "quorum failure" with an
+                        # empty error list
+                        timeout=self.helper.default_timeout,
+                        stream_factory=lambda i=i: bytes_stream(pieces[i]),
+                    )
                 ok.add((n, i))
             except Exception as e:  # noqa: BLE001 — tallied for Quorum
                 failed.add((n, i))
@@ -559,9 +571,13 @@ class BlockManager:
 
         sender = spawn(send_all(), name=f"ec-put-{hash32.hex()[:8]}")
         try:
-            await asyncio.wait_for(
-                done_ev.wait(), self.helper.default_timeout + 5.0
-            )
+            # quorum_wait's EXCLUSIVE time subtracts the fan-out window
+            # (utils/latency.py RESIDUAL_OF): what's left is the tail
+            # where sends finished but a quorum still hadn't
+            with phase_span("quorum_wait"):
+                await asyncio.wait_for(
+                    done_ev.wait(), self.helper.default_timeout + 5.0
+                )
         except asyncio.TimeoutError:
             pass
         if not satisfied():
@@ -628,38 +644,45 @@ class BlockManager:
     async def _rpc_get_block(
         self, hash32: bytes, prio: int = PRIO_NORMAL, order_tag=None
     ) -> bytes:
+        from ..utils.latency import phase_span
+
         if self.codec.n_pieces == 1:
-            local = await self.read_block_local(hash32)
-            if local is not None:
-                return local
-            nodes = self.helper.request_order(self.read_nodes_of(hash32))
-            errors = []
-            for n in nodes:
-                if n == self.system.id:
-                    continue
-                try:
-                    # health-tracked + retried: a sick peer fast-fails
-                    # (circuit breaker) instead of stalling the GET, and
-                    # transient transport blips retry with jittered backoff
-                    resp = await self.helper.call(
-                        self.endpoint, n, ["Get", hash32], prio=prio,
-                        order_tag=order_tag, idempotent=True,
-                    )
-                    declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
-                    # reserve before buffering; held through decompress+verify
-                    async with self.buffers.reserve(declared):
-                        meta, stored = await _resp_payload(resp)
-                        data = (
-                            zstandard.decompress(stored)
-                            if meta.get("c")
-                            else stored
+            with phase_span("piece_fetch"):
+                local = await self.read_block_local(hash32)
+                if local is not None:
+                    return local
+                nodes = self.helper.request_order(self.read_nodes_of(hash32))
+                errors = []
+                for n in nodes:
+                    if n == self.system.id:
+                        continue
+                    try:
+                        # health-tracked + retried: a sick peer fast-fails
+                        # (circuit breaker) instead of stalling the GET,
+                        # and transient transport blips retry with
+                        # jittered backoff
+                        resp = await self.helper.call(
+                            self.endpoint, n, ["Get", hash32], prio=prio,
+                            order_tag=order_tag, idempotent=True,
                         )
-                        if blake2sum(data) != hash32:
-                            raise Error("hash mismatch from peer")
-                        return data
-                except Exception as e:  # noqa: BLE001
-                    errors.append(f"{n.hex()[:8]}: {e!r}")
-            raise Error(f"block {hash32.hex()[:16]} unavailable: {errors}")
+                        declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
+                        # reserve before buffering; held through
+                        # decompress+verify
+                        async with self.buffers.reserve(declared):
+                            meta, stored = await _resp_payload(resp)
+                            data = (
+                                zstandard.decompress(stored)
+                                if meta.get("c")
+                                else stored
+                            )
+                            if blake2sum(data) != hash32:
+                                raise Error("hash mismatch from peer")
+                            return data
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{n.hex()[:8]}: {e!r}")
+                raise Error(
+                    f"block {hash32.hex()[:16]} unavailable: {errors}"
+                )
         return await self._ec_get(hash32, prio, order_tag)
 
     async def _fetch_piece(
@@ -757,13 +780,17 @@ class BlockManager:
     async def _ec_get(self, hash32: bytes, prio, order_tag=None) -> bytes:
         """Gather k pieces and decode; the plaintext block hash is verified
         after decode, so corrupted pieces are caught end-to-end."""
+        from ..utils.latency import phase_span
+
         k = self.codec.min_pieces
-        blen, pieces = await self.gather_pieces(
-            hash32, k, prio, order_tag=order_tag
-        )
-        data = self.codec.decode(pieces, blen)
-        if blake2sum(data) != hash32:
-            raise Error("EC decode does not match block hash")
+        with phase_span("piece_fetch"):
+            blen, pieces = await self.gather_pieces(
+                hash32, k, prio, order_tag=order_tag
+            )
+        with phase_span("decode"):
+            data = self.codec.decode(pieces, blen)
+            if blake2sum(data) != hash32:
+                raise Error("EC decode does not match block hash")
         return data
 
     def _verify_gathered(self, hash32: bytes, pieces: dict[int, bytes], blen: int):

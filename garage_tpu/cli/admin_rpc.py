@@ -579,6 +579,11 @@ class AdminRpcHandler:
 
         return flight.slow_response(getattr(self.garage, "flight_recorder", None))
 
+    async def op_debug_latency(self, args) -> Any:
+        from ..utils.latency import latency_response
+
+        return latency_response()
+
     async def op_meta_snapshot(self, args) -> Any:
         from ..model.snapshot import take_snapshot
 

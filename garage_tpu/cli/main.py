@@ -177,6 +177,10 @@ def main(argv=None):
     )
     dpr.add_argument("-o", "--output", help="write to a file instead of stdout")
     dbg_sub.add_parser("slow", help="slowest recent requests (span trees)")
+    dbg_sub.add_parser(
+        "latency",
+        help="latency X-ray: rolling per-phase waterfall per S3 op",
+    )
     rep = sub.add_parser("repair")
     rep.add_argument(
         "what",
@@ -337,6 +341,12 @@ async def run_server(config_path: str) -> None:
         host, port = _parse_addr(config.s3_api.api_bind_addr)
         await s3.start(host, port)
         servers.append(s3)
+        if config.admin.canary_enabled:
+            # canary prober (api/s3/canary.py): probe through this
+            # node's own S3 frontend; a wildcard bind probes loopback
+            probe_host = host if host not in ("0.0.0.0", "::") else "127.0.0.1"
+            bound_port = s3.runner.addresses[0][1]
+            garage.spawn_canary(f"http://{probe_host}:{bound_port}")
     if config.k2v_api.api_bind_addr:
         from ..api.k2v.api_server import K2VApiServer
 
@@ -434,10 +444,13 @@ def _render_cluster_top(r: dict) -> str:
             f"(burn {slo['latencyP99']['burnRate']:.2f})"
         )
     out = format_table(head) + "\n\n"
-    rows = ["id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tflags"]
+    rows = [
+        "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry\tflags"
+    ]
     for n in r.get("nodes", []):
         d = n.get("digest") or {}
         s3 = d.get("s3") or {}
+        cn = d.get("canary") or {}
         flags = []
         if n.get("isSelf"):
             flags.append("self")
@@ -445,6 +458,17 @@ def _render_cluster_top(r: dict) -> str:
             flags.append("OUTLIER")
         if not d:
             flags.append("no-digest")
+        # recency, not history: flag the LAST cycle's verdict — a single
+        # transient failed leg must not mark a recovered node forever
+        if cn.get("ok") == 0:
+            flags.append("CANARY-FAIL")
+        # canary column: probe p99 + cumulative failures, "-" when the
+        # node runs no prober (or hasn't probed yet)
+        cnry = (
+            f"{_ms(cn.get('p99'))}/{cn.get('err', 0):g}"
+            if cn.get("ops")
+            else "-"
+        )
         rows.append(
             f"{n['id'][:16]}\t{n.get('hostname', '?')}\t"
             f"{'y' if n.get('isUp') else 'n'}\t{n.get('ageSecs', 0):.0f}s\t"
@@ -452,6 +476,7 @@ def _render_cluster_top(r: dict) -> str:
             f"{_ms(s3.get('p99'))}\t{_ms((d.get('loop') or {}).get('p99'))}\t"
             f"{(d.get('resync') or {}).get('q', 0)}\t"
             f"{(d.get('rpc') or {}).get('open', 0)}\t"
+            f"{cnry}\t"
             f"{','.join(flags) or '-'}"
         )
     out += format_table(rows)
@@ -787,6 +812,36 @@ async def dispatch(args, call, config) -> str | None:
                     f"({r['samples']} sampling rounds) to {args.output}"
                 )
             return body
+        if args.debug_cmd == "latency":
+            r = await call("debug-latency")
+            if jd:
+                return jd(r)
+            if not r["enabled"]:
+                return (
+                    "latency X-ray disabled ([admin] latency_xray = false)"
+                )
+            if not r["ops"]:
+                return "no attributed requests recorded yet"
+            out_parts = []
+            for op, st in sorted(r["ops"].items()):
+                w = st["wallMs"]
+                rows = [
+                    f"== {op} ==\t({st['count']} reqs)",
+                    f"wall ms p50/p95/p99\t"
+                    f"{w['p50']:.1f} / {w['p95']:.1f} / {w['p99']:.1f}",
+                    f"coverage\t{st['coverage'] * 100:.0f}%",
+                    f"overlap efficiency\t{st['overlapEfficiency']:.2f} "
+                    "(1.0 = fully sequential)",
+                    "phase\tp50ms\tp95ms\tp99ms\tshare",
+                ]
+                for ph, ps in st["phases"].items():
+                    rows.append(
+                        f"{ph}\t{ps['p50']:.1f}\t{ps['p95']:.1f}\t"
+                        f"{ps['p99']:.1f}\t"
+                        f"{ps['criticalPathShare'] * 100:.0f}%"
+                    )
+                out_parts.append(format_table(rows))
+            return "\n\n".join(out_parts)
         if args.debug_cmd == "slow":
             r = await call("debug-slow")
             if jd:
@@ -800,13 +855,18 @@ async def dispatch(args, call, config) -> str | None:
                 return (
                     f"no requests above {r['thresholdMs']:g} ms recorded"
                 )
-            rows = ["trace\tname\tms\tspans\tok\tattrs"]
+            rows = ["trace\tname\tms\tspans\tok\ttop phases\tattrs"]
             for q in r["requests"]:
                 attrs = ",".join(f"{k}={v}" for k, v in q["attrs"].items())
+                wf = q.get("phases") or {}
+                top = ", ".join(
+                    f"{ph} {st['ms']:.0f}ms"
+                    for ph, st in list((wf.get("phases") or {}).items())[:3]
+                )
                 rows.append(
                     f"{q['traceId'][:16]}\t{q['name']}\t"
                     f"{q['durationMs']:.1f}\t{len(q['spans'])}\t"
-                    f"{'y' if q['ok'] else 'n'}\t{attrs}"
+                    f"{'y' if q['ok'] else 'n'}\t{top or '-'}\t{attrs}"
                 )
             return format_table(rows)
 

@@ -335,6 +335,9 @@ class Garage:
         # flight recorder plane (utils/flight.py), wired in start()
         self.flight_recorder = None
         self.watchdog = None
+        # latency X-ray + canary prober (utils/latency.py, api/s3/canary.py)
+        self._latency_enabled = False
+        self.canary = None
 
         # cluster telemetry plane (rpc/telemetry_digest.py): local digest
         # collection piggybacked on the status gossip + S3 SLO budgets
@@ -397,6 +400,14 @@ class Garage:
                 threshold=adm.event_loop_watchdog_threshold_msec / 1000.0
             )
             self.watchdog.start()
+        if adm.latency_xray:
+            # latency X-ray (utils/latency.py): phase attribution via a
+            # span-end hook — like the flight recorder, attaching it
+            # turns span creation on with no OTLP sink
+            from ..utils import latency
+
+            latency.enable()
+            self._latency_enabled = True
         self._register_gauges()
         # uptime measures SERVING time: restamp at start(), not object
         # construction (recovery work can run between the two)
@@ -408,7 +419,9 @@ class Garage:
         src/block/metrics.rs, src/table/metrics.rs)."""
         from ..utils.metrics import registry
 
-        self._gauge_keys: list[tuple] = []
+        # preserve keys tracked before start() (a canary spawned early):
+        # reassigning would orphan their registry entries at stop()
+        self._gauge_keys: list[tuple] = getattr(self, "_gauge_keys", [])
 
         def reg(name: str, labels: tuple, fn) -> None:
             registry.register_gauge(name, labels, fn)
@@ -470,6 +483,48 @@ class Garage:
         ):
             self.launch_repair_plan()
 
+    # --- canary prober --------------------------------------------------------
+
+    def spawn_canary(self, endpoint: str):
+        """Start the background canary prober against this node's own S3
+        frontend (`endpoint`).  Called by the daemon once the S3 server
+        is listening; tests call it directly.  Registers the
+        `canary_healthy{id}` gauge at spawn (unregistered at stop() via
+        _gauge_keys, process-unique id) and the `canary-*` live BgVars."""
+        from ..api.s3.canary import CanaryWorker
+        from ..utils.metrics import registry
+
+        adm = self.config.admin
+        w = CanaryWorker(
+            self,
+            endpoint,
+            interval=adm.canary_interval_secs,
+            object_bytes=adm.canary_object_bytes,
+            bucket=adm.canary_bucket,
+        )
+        self.canary = w
+        self.bg.spawn(w)
+        self.bg_vars.register_rw(
+            "canary-interval-secs",
+            lambda: str(w.interval),
+            lambda v: setattr(w, "interval", max(0.05, float(v))),
+        )
+        self.bg_vars.register_rw(
+            "canary-object-bytes",
+            lambda: str(w.object_bytes),
+            lambda v: setattr(w, "object_bytes", max(1, int(v))),
+        )
+        lbl = (("id", w.gauge_id),)
+        # fn raising on None (no cycle yet) drops the sample at scrape
+        registry.register_gauge(
+            "canary_healthy", lbl, lambda: float(w.healthy)
+        )
+        # _gauge_keys normally exists by now (start() ran); a canary
+        # spawned before start() must not crash, just track its key
+        self._gauge_keys = getattr(self, "_gauge_keys", [])
+        self._gauge_keys.append(("canary_healthy", lbl))
+        return w
+
     # --- repair plane ---------------------------------------------------------
 
     def launch_repair_plan(self, fresh: bool = False):
@@ -520,7 +575,17 @@ class Garage:
         if self.flight_recorder is not None:
             tracer.remove_hook(self.flight_recorder.on_span_end)
             self.flight_recorder = None
+        if self._latency_enabled:
+            from ..utils import latency
+
+            latency.disable()
+            self._latency_enabled = False
         await self.bg.shutdown()
+        if self.canary is not None:
+            # after bg.shutdown(): the worker is cancelled, nothing is
+            # mid-probe on this session anymore
+            await self.canary.stop_client()
+            self.canary = None
         await self.system.stop()
         await self.netapp.shutdown()
         if self.config.admin.trace_sink:

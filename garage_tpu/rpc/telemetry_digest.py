@@ -184,6 +184,21 @@ class DigestCollector:
                 "plat": ",".join(platforms_seen()) or None,
             },
         }
+        # canary prober health (api/s3/canary.py): cumulative probes,
+        # failures, probe p99 — all-zero on nodes without a prober, so
+        # `cluster top` can tell "no canary" from "canary failing"
+        from ..api.s3.canary import digest_fields as _canary_fields
+
+        cn = _canary_fields(r)
+        cn["p99"] = _finite(cn["p99"])
+        # last-cycle verdict from the live worker (1 ok / 0 failing /
+        # absent before the first cycle or without a prober): the
+        # cumulative `err` count flags a node forever after one transient
+        # blip — recency is what `cluster top`'s CANARY-FAIL keys off
+        w = getattr(g, "canary", None)
+        if w is not None and w.healthy is not None:
+            cn["ok"] = w.healthy
+        digest["canary"] = cn
         slo = getattr(g, "slo_tracker", None)
         if slo is not None:
             digest["slo"] = slo.digest_fields()
@@ -560,6 +575,12 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
      ("rpc", "open")),
     ("cluster_node_tpu_dispatch_per_second", "TPU codec dispatch rate",
      ("tpu", "dps")),
+    ("cluster_node_canary_probes", "cumulative canary probe legs",
+     ("canary", "ops")),
+    ("cluster_node_canary_errors", "cumulative failed canary probe legs",
+     ("canary", "err")),
+    ("cluster_node_canary_p99_seconds", "canary probe latency p99",
+     ("canary", "p99")),
     ("cluster_node_disk_avail_bytes", "free disk bytes (meta dir)",
      lambda row: (row.get("metaDiskAvail") or (None,))[0]),
 ]

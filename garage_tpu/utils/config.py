@@ -73,6 +73,21 @@ class AdminConfig:
     slo_availability_target: float = 99.9  # percent
     slo_latency_p99_target_msec: float = 1000.0
     slo_window_secs: float = 3600.0
+    # latency X-ray (utils/latency.py): phase-level critical-path
+    # attribution of S3 requests, served from /v1/debug/latency — on by
+    # default, zero external collectors (span-end hook like the flight
+    # recorder)
+    latency_xray: bool = True
+    # canary prober (api/s3/canary.py): low-rate synthetic PUT/GET/DELETE
+    # against a hidden bucket so the waterfall, SLO budgets and outlier
+    # detector have signal on an idle cluster.  Spawned by the daemon
+    # when the S3 API is enabled.
+    canary_enabled: bool = True
+    canary_interval_secs: float = 60.0
+    canary_object_bytes: int = 65536
+    # must be a valid S3 bucket name; "hidden" because only the canary's
+    # own key is authorized on it (ListBuckets is per-key)
+    canary_bucket: str = "canary-probe"
 
 
 @dataclass
@@ -425,6 +440,14 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         raise ValueError("slo_latency_p99_target_msec must be > 0")
     if float(cfg.admin.slo_window_secs) <= 0:
         raise ValueError("slo_window_secs must be > 0")
+    # canary knobs: an interval of 0 would busy-loop synthetic traffic
+    # through the full S3 stack; an empty bucket name can't be created
+    if float(cfg.admin.canary_interval_secs) <= 0:
+        raise ValueError("canary_interval_secs must be > 0")
+    if int(cfg.admin.canary_object_bytes) < 1:
+        raise ValueError("canary_object_bytes must be >= 1")
+    if not str(cfg.admin.canary_bucket).strip():
+        raise ValueError("canary_bucket must be a non-empty bucket name")
     # resolve secrets
     cfg.rpc_secret = _get_secret(
         cfg.rpc_secret,

@@ -452,6 +452,16 @@ class SlowRequestRecorder:
         if duration_ms < self.threshold_ms:
             return
         t0 = root.start_ns
+        # phase waterfall (utils/latency.py): "why was THIS request
+        # slow" answered per-phase, not just as a raw span tree
+        try:
+            from .latency import critical_path
+
+            waterfall = critical_path(root, tree)
+            if not waterfall["phases"]:
+                waterfall = None
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            waterfall = None
         self.records.append(
             {
                 "traceId": root.trace_id.hex(),
@@ -459,6 +469,7 @@ class SlowRequestRecorder:
                 "start": root.start_ns / 1e9,
                 "durationMs": round(duration_ms, 3),
                 "ok": root.ok,
+                "phases": waterfall,
                 "attrs": {k: str(v) for k, v in root.attrs.items()},
                 "spans": [
                     {

@@ -114,6 +114,17 @@ class Metrics:
                 continue
         return total
 
+    def histogram_family_count(self, name: str, pred=None) -> int:
+        """Total observations of a histogram family across label sets
+        (optionally only those where `pred(labels_tuple)` holds) — e.g.
+        how many canary probes errored, straight from the duration
+        histogram's counts without a parallel counter family."""
+        return sum(
+            cnt
+            for (n, labels), (cnt, _total, _buckets) in self.durations.items()
+            if n == name and (pred is None or pred(labels))
+        )
+
     def family_merge(self, name: str) -> tuple[int, float, list[int]] | None:
         """Merge a histogram family across all its label sets into one
         (count, sum, per-bucket counts) triple — the cluster digest wants

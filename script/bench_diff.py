@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Perf-regression gate: committed bench artifacts must not silently rot.
+
+The repo banks benchmark results as committed `BENCH_*.json` artifacts
+(bench.py / bench_s3.py / bench_repair.py `--artifact`), and PRs quote
+them — but until now nothing *checked* them, so a regression that
+re-banked a worse artifact (or deleted one) would sail through CI.  This
+gate declares a floor per tracked metric and fails when a committed
+artifact violates it.  It runs two ways:
+
+  - as a tier-1 test (tests/test_bench_diff.py) over the repo's own
+    artifacts, so the bench trajectory is CI-enforced;
+  - as a CLI for local/driver use:
+
+        python script/bench_diff.py [--root /path/to/repo]
+
+Floors are intentionally conservative: they encode "never worse than
+this" (a regression tripwire), not the current number (which would make
+every noisy re-run a CI failure).  Tightening a floor after a real win
+is part of banking that win — the future PUT-pipeline PR is expected to
+ratchet `s3_put_p99_ec_over_replica` down once it lands.
+
+Artifact values are addressed by dotted path into the JSON (e.g.
+`detail.ec_ms.put_p99`); `op` is one of `<=` (ceilings: latency ratios)
+or `>=` (floors: throughput, vs_baseline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# artifact file -> [(dotted value path, op, bound, what it guards)]
+FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
+    "BENCH_s3_geometry.json": [
+        # measured 3.16x on CPU loopback (PR 2); ROADMAP item 1 targets
+        # <= 1.5x — the ceiling trips if the gap WIDENS past 4x
+        ("value", "<=", 4.0, "EC(8,3)/3-replica S3 PUT p99 ratio"),
+        ("vs_baseline", ">=", 0.25, "PUT p99 ratio vs the 1.2x target"),
+    ],
+    "BENCH_repair_10k.json": [
+        # measured 178.5 blocks/s on CPU loopback (PR 4); floor matches
+        # tests/test_repair_plan.py's artifact floor
+        ("repair_blocks_per_s", ">=", 20.0, "repair-plane throughput"),
+        ("repaired", ">=", 10000, "full 10k-block population repaired"),
+        ("mesh_engaged", ">=", 1, "TPU/mesh dispatch actually engaged"),
+    ],
+    "BENCH_r05.json": [
+        # 6.2 GB/s CPU-fallback encode = vs_baseline 0.62 (10 GB/s
+        # baseline); the floor trips if encode falls below ~3 GB/s
+        ("parsed.vs_baseline", ">=", 0.3, "EC(8,3) encode GB/s vs baseline"),
+    ],
+}
+
+
+def _lookup(obj, path: str):
+    cur = obj
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def check_artifact(
+    path: str, floors: list[tuple[str, str, float, str]]
+) -> list[str]:
+    """Violations for one artifact file (missing file / missing value /
+    non-numeric value are violations too — the gate must not silently
+    pass because an artifact was deleted or reshaped)."""
+    name = os.path.basename(path)
+    if not os.path.exists(path):
+        return [f"{name}: artifact missing (floors declared for it)"]
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{name}: unreadable artifact: {e}"]
+    errors = []
+    for vpath, op, bound, what in floors:
+        raw = _lookup(data, vpath)
+        try:
+            val = float(raw)
+        except (TypeError, ValueError):
+            errors.append(
+                f"{name}: {vpath} missing or non-numeric ({raw!r}) — "
+                f"guards {what}"
+            )
+            continue
+        ok = val <= bound if op == "<=" else val >= bound
+        if not ok:
+            errors.append(
+                f"{name}: {vpath} = {val:g} violates declared floor "
+                f"{op} {bound:g} ({what})"
+            )
+    return errors
+
+
+def check_all(root: str = REPO, floors=None) -> list[str]:
+    errors = []
+    for fname, fl in sorted((floors or FLOORS).items()):
+        errors.extend(check_artifact(os.path.join(root, fname), fl))
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=REPO, help="repo root with BENCH_*.json")
+    args = ap.parse_args(argv)
+    errors = check_all(args.root)
+    for e in errors:
+        print(f"REGRESSION: {e}", file=sys.stderr)
+    if not errors:
+        n = sum(len(v) for v in FLOORS.values())
+        print(f"bench diff ok: {n} floors across {len(FLOORS)} artifacts hold")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,62 @@
+"""Perf-regression gate (script/bench_diff.py): the committed bench
+artifacts must satisfy their declared floors, and an injected regression
+must actually trip the gate (a gate that can't fail is no gate)."""
+
+import json
+import os
+import shutil
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "script")
+)
+
+from bench_diff import FLOORS, REPO, check_all, check_artifact, main
+
+
+def test_committed_artifacts_satisfy_declared_floors():
+    errors = check_all(REPO)
+    assert errors == [], errors
+    assert main(["--root", REPO]) == 0
+
+
+def test_injected_regression_fixture_fails_the_gate(tmp_path):
+    # start from the real (passing) artifacts...
+    for fname in FLOORS:
+        shutil.copy(os.path.join(REPO, fname), tmp_path / fname)
+    assert check_all(str(tmp_path)) == []
+    # ...then regress one: repair throughput collapses to 1 block/s
+    with open(tmp_path / "BENCH_repair_10k.json") as f:
+        art = json.load(f)
+    art["repair_blocks_per_s"] = 1.0
+    with open(tmp_path / "BENCH_repair_10k.json", "w") as f:
+        json.dump(art, f)
+    errors = check_all(str(tmp_path))
+    assert any("repair_blocks_per_s" in e for e in errors), errors
+    assert main(["--root", str(tmp_path)]) == 1
+
+    # and widen the EC/replica PUT p99 gap past the ceiling
+    with open(tmp_path / "BENCH_s3_geometry.json") as f:
+        art = json.load(f)
+    art["value"] = 9.7
+    with open(tmp_path / "BENCH_s3_geometry.json", "w") as f:
+        json.dump(art, f)
+    errors = check_all(str(tmp_path))
+    assert any("BENCH_s3_geometry" in e and "9.7" in e for e in errors)
+
+
+def test_missing_or_malformed_artifact_is_a_violation(tmp_path):
+    for fname in FLOORS:
+        shutil.copy(os.path.join(REPO, fname), tmp_path / fname)
+    os.remove(tmp_path / "BENCH_r05.json")
+    errors = check_all(str(tmp_path))
+    assert any("BENCH_r05.json" in e and "missing" in e for e in errors)
+
+    # a reshaped artifact (value path gone) must not silently pass
+    with open(tmp_path / "BENCH_s3_geometry.json", "w") as f:
+        json.dump({"metric": "s3_put_p99_ec_over_replica"}, f)
+    errors = check_artifact(
+        str(tmp_path / "BENCH_s3_geometry.json"),
+        FLOORS["BENCH_s3_geometry.json"],
+    )
+    assert any("missing or non-numeric" in e for e in errors)

@@ -1,0 +1,412 @@
+"""Latency X-ray (utils/latency.py): phase-level critical-path
+attribution, the canary prober, and the /v1/debug/latency waterfall.
+
+Acceptance (ISSUE 6): on an in-process 11-node EC(8,3) cluster,
+GET /v1/debug/latency attributes >= 80% of PUT wall time to named
+phases, reports overlap efficiency, and the canary prober populates
+`canary_probe_duration` plus the cluster telemetry digest with zero
+foreground traffic.
+"""
+
+import asyncio
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(
+    0, os.path.join(os.path.dirname(__file__), os.pardir, "script")
+)
+
+from garage_tpu.utils.latency import (
+    OPS,
+    PHASES,
+    PhaseAggregator,
+    aggregator,
+    critical_path,
+)
+from garage_tpu.utils.metrics import Metrics
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class S:
+    """Span-like stub for synthetic trees (times in ms for legibility)."""
+
+    def __init__(self, name, sid, pid, start_ms, end_ms, **attrs):
+        self.name = name
+        self.span_id = sid
+        self.parent_id = pid
+        self.start_ns = int(start_ms * 1e6)
+        self.end_ns = int(end_ms * 1e6)
+        self.attrs = attrs
+        self.trace_id = b"t" * 16
+        self.ok = True
+
+
+# --- critical-path math -------------------------------------------------------
+
+
+def test_critical_path_merges_parallel_fanout_and_residual_quorum():
+    """Parallel same-phase spans must not double-count, and quorum_wait
+    only keeps the tail not covered by the fan-out window."""
+    root = S("api:s3", b"r", None, 0, 100, op="put")
+    spans = [
+        root,
+        S("phase:encode", b"e", b"r", 0, 10, phase="encode"),
+        # two overlapping fan-out sends: 50ms each over a 60ms window
+        S("phase:fanout", b"f1", b"r", 10, 60, phase="fanout"),
+        S("phase:fanout", b"f2", b"r", 20, 70, phase="fanout"),
+        # the quorum wait spans the whole send window + a 10ms tail
+        S("phase:quorum_wait", b"q", b"r", 10, 80, phase="quorum_wait"),
+        S("phase:meta_commit", b"m", b"r", 80, 100, phase="meta_commit"),
+    ]
+    r = critical_path(root, spans)
+    assert r["phases"]["fanout"]["ms"] == 60.0  # merged, not 100
+    assert r["phases"]["quorum_wait"]["ms"] == 10.0  # residual tail only
+    assert r["phases"]["encode"]["ms"] == 10.0
+    assert r["phases"]["meta_commit"]["ms"] == 20.0
+    assert abs(r["coverage"] - 1.0) < 1e-6
+    # fully sequential attribution: wall == sum of phases
+    assert abs(r["overlapEfficiency"] - 1.0) < 1e-6
+    assert abs(sum(p["share"] for p in r["phases"].values()) - 1.0) < 1e-3
+
+
+def test_critical_path_nested_phase_exclusive_time_and_overlap():
+    """A different-phase descendant is cut out of its ancestor's
+    interval; genuine cross-task overlap pushes efficiency below 1."""
+    root = S("api:s3", b"r", None, 0, 100, op="put")
+    f1 = S("phase:fanout", b"f1", b"r", 10, 60, phase="fanout")
+    # hash nested INSIDE the first fan-out span: exclusive fanout loses it
+    h = S("phase:hash", b"h", b"f1", 30, 40, phase="hash")
+    spans = [root, f1, h]
+    r = critical_path(root, spans)
+    assert r["phases"]["fanout"]["ms"] == 40.0  # 50 - 10 nested hash
+    assert r["phases"]["hash"]["ms"] == 10.0
+    assert r["coverage"] == 0.5  # [10,60] of 100
+
+    # parallel chunk (another task) overlapping fanout: both count, so
+    # sum (90) > wall-covered time -> overlap efficiency below 1 when the
+    # request wall equals the attributed window
+    root2 = S("api:s3", b"r", None, 0, 60, op="put")
+    spans2 = [
+        root2,
+        S("phase:fanout", b"f", b"r", 0, 50, phase="fanout"),
+        S("phase:chunk", b"c", b"r", 10, 50, phase="chunk"),
+    ]
+    r2 = critical_path(root2, spans2)
+    assert r2["sumMs"] == 90.0
+    assert abs(r2["overlapEfficiency"] - 60.0 / 90.0) < 1e-3
+    # sequentiality = attributed-union / sum: coverage-independent
+    assert abs(r2["sequentiality"] - 50.0 / 90.0) < 1e-3
+
+
+def test_critical_path_clips_background_stragglers_to_root_window():
+    root = S("api:s3", b"r", None, 0, 50, op="put")
+    # a straggler send finishing 100ms after the response went out
+    spans = [root, S("phase:fanout", b"f", b"r", 40, 150, phase="fanout")]
+    r = critical_path(root, spans)
+    assert r["phases"]["fanout"]["ms"] == 10.0  # clipped at root end
+
+
+def test_aggregator_enforces_the_closed_catalogue():
+    """Spans with a phase outside the catalogue (or an unknown op) never
+    reach the histograms — {op,phase} cardinality is bounded."""
+    reg = Metrics()
+    agg = PhaseAggregator(registry=reg)
+    root = S("api:s3", b"r", None, 0, 100, op="put")
+    weird = S("phase:weird", b"w", b"r", 0, 50, phase="weird")
+    okspan = S("phase:encode", b"e", b"r", 50, 80, phase="encode")
+    for s in (weird, okspan, root):
+        agg.on_span_end(s)
+    fams = [(n, dict(labels)) for (n, labels) in reg.durations]
+    assert (
+        "api_s3_phase_duration", {"op": "put", "phase": "encode"}
+    ) in fams
+    assert not any(lbl.get("phase") == "weird" for _n, lbl in fams)
+
+    # unknown op: nothing recorded at all
+    agg2 = PhaseAggregator(registry=Metrics())
+    root2 = S("api:s3", b"r", None, 0, 100, op="exotic")
+    agg2.on_span_end(S("phase:encode", b"e", b"r", 0, 10, phase="encode"))
+    agg2.on_span_end(root2)
+    assert agg2.recorded == 0
+    # non-api roots (background table ops) are dropped, not buffered
+    agg2.on_span_end(S("table:insert", b"x", None, 0, 10))
+    assert not agg2.pending
+
+
+def test_aggregator_skips_truncated_traces():
+    """A trace overflowing the span buffer records NOTHING — an absent
+    sample is honest, a waterfall missing its tail phases is corrupt."""
+    agg = PhaseAggregator(registry=Metrics())
+    agg.MAX_SPANS_PER_TRACE = 4
+    for i in range(6):
+        agg.on_span_end(
+            S("phase:fanout", bytes([i]), b"r", i, i + 1, phase="fanout")
+        )
+    agg.on_span_end(S("api:s3", b"r", None, 0, 100, op="put"))
+    assert agg.recorded == 0
+    assert not agg.pending
+
+
+# --- live daemon: phases on PUT / streamed GET / multipart ----------------
+
+
+def test_put_get_multipart_phase_waterfall(tmp_path):
+    from test_s3_api import make_client, make_daemon, teardown
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("xray")
+            aggregator.reset()
+
+            big = os.urandom(20_000)  # multi-block at block_size=4096
+            await client.put_object("xray", "obj", big)
+            got = await client.get_object("xray", "obj")  # streamed GET
+            assert got == big
+            up = await client.create_multipart_upload("xray", "mp")
+            e1 = await client.upload_part("xray", "mp", up, 1, os.urandom(9_000))
+            e2 = await client.upload_part("xray", "mp", up, 2, os.urandom(5_000))
+            await client.complete_multipart_upload(
+                "xray", "mp", up, [(1, e1), (2, e2)]
+            )
+
+            snap = aggregator.snapshot()
+            assert {"put", "get", "upload_part"} <= set(snap)
+            put = snap["put"]
+            assert put["count"] >= 1
+            assert {"chunk", "hash", "fanout", "meta_commit"} <= set(
+                put["phases"]
+            )
+            assert 0.0 < put["coverage"] <= 1.0
+            assert put["overlapEfficiency"] > 0
+            get = snap["get"]
+            # streamed GET: index read + block fetch + stream-out
+            assert {"index_read", "piece_fetch", "stream_out"} <= set(
+                get["phases"]
+            )
+            upp = snap["upload_part"]
+            assert {"chunk", "meta_commit"} <= set(upp["phases"])
+            # shares are a distribution over the attributed time
+            for op_stats in snap.values():
+                total_share = sum(
+                    p["criticalPathShare"] for p in op_stats["phases"].values()
+                )
+                assert abs(total_share - 1.0) < 1e-2
+
+            # registry exposition: every {op,phase} combo is catalogued
+            from garage_tpu.utils.metrics import registry
+
+            for (name, labels) in registry.durations:
+                if name != "api_s3_phase_duration":
+                    continue
+                lbl = dict(labels)
+                assert lbl["op"] in OPS, labels
+                assert lbl["phase"] in PHASES, labels
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+def test_slow_ring_entries_carry_phase_waterfall(tmp_path):
+    """Satellite: /v1/debug/slow answers "why was THIS request slow"
+    per-phase, not just as a span tree."""
+    from test_s3_api import make_client, make_daemon, teardown
+
+    from garage_tpu.utils import flight
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            # every request is "slow" at threshold 0
+            garage.flight_recorder.threshold_ms = 0.0
+            client = await make_client(garage, endpoint)
+            await client.create_bucket("slowp")
+            await client.put_object("slowp", "k", os.urandom(15_000))
+            resp = flight.slow_response(garage.flight_recorder)
+            puts = [
+                r for r in resp["requests"]
+                if r["attrs"].get("method") == "PUT" and r.get("phases")
+            ]
+            assert puts, resp["requests"]
+            wf = puts[0]["phases"]
+            assert wf["wallMs"] > 0
+            assert "meta_commit" in wf["phases"]
+            assert 0 < wf["coverage"] <= 1.0
+        finally:
+            await teardown(garage, s3)
+
+    run(main())
+
+
+# --- canary prober ------------------------------------------------------------
+
+
+def test_canary_worker_lifecycle_and_digest(tmp_path):
+    """Gauges registered at spawn / unregistered at shutdown (PR 3
+    convention, process-unique id), probe families populated, canary
+    block in the telemetry digest — with zero foreground traffic."""
+    from test_s3_api import make_daemon, teardown
+
+    from garage_tpu.rpc.telemetry_digest import DigestCollector
+    from garage_tpu.utils.metrics import registry
+
+    async def main():
+        garage, s3, endpoint = await make_daemon(tmp_path)
+        try:
+            garage.config.admin.canary_interval_secs = 0.05
+            garage.config.admin.canary_object_bytes = 8_192
+            w = garage.spawn_canary(endpoint)
+            for _ in range(400):
+                await asyncio.sleep(0.05)
+                if w.probes >= 2:
+                    break
+            assert w.probes >= 2, w.status()
+            assert w.failed == 0, w.status()
+            assert w.healthy == 1.0
+
+            text = "\n".join(registry.render())
+            # probe legs landed, all ok
+            assert (
+                'canary_probe_duration_bucket{op="put",outcome="ok"' in text
+            )
+            assert 'canary_probe_duration_count{op="get",outcome="ok"}' in text
+            assert 'canary_probe_duration_count{op="delete",outcome="ok"}' in text
+            # the spawn-registered gauge, process-unique id label
+            assert re.search(
+                r'canary_healthy\{id="%s"\} 1' % w.gauge_id, text
+            ), text[:200]
+            # worker runtime families (BackgroundRunner convention)
+            assert 'worker_state{worker="canary"' in text
+
+            # live BgVars
+            assert garage.bg_vars.get("canary-interval-secs") == "0.05"
+            garage.bg_vars.set("canary-object-bytes", "4096")
+            assert w.object_bytes == 4096
+
+            # telemetry digest: canary block present and counting
+            dig = DigestCollector(garage).collect()
+            assert dig["canary"]["ops"] >= 3
+            assert dig["canary"]["err"] == 0
+            assert dig["canary"]["p99"] is not None
+
+            # process-unique gauge ids across workers
+            from garage_tpu.api.s3.canary import CanaryWorker
+
+            w2 = CanaryWorker(garage, endpoint)
+            assert w2.gauge_id != w.gauge_id
+        finally:
+            await teardown(garage, s3)
+        # shutdown unregisters the canary + worker gauges
+        text = "\n".join(registry.render())
+        assert f'canary_healthy{{id="{w.gauge_id}"}}' not in text
+        assert 'worker_state{worker="canary"' not in text
+
+    run(main())
+
+
+# --- acceptance: 11-node EC(8,3) ---------------------------------------------
+
+
+def test_ec83_cluster_xray_acceptance(tmp_path):
+    from test_ec_cluster import make_ec_cluster, stop_cluster
+
+    from garage_tpu.api.admin.api_server import AdminApiServer
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.s3.client import S3Client
+    from garage_tpu.rpc.telemetry_digest import DigestCollector
+    from garage_tpu.utils.metrics import registry
+
+    async def main():
+        garages = await make_ec_cluster(
+            tmp_path, n=11, mode="ec:8:3", block_size=65536
+        )
+        s3 = S3ApiServer(garages[0])
+        await s3.start("127.0.0.1", 0)
+        ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
+        garages[0].config.admin.admin_token = "xray-admin-token"
+        admin = AdminApiServer(garages[0])
+        await admin.start("127.0.0.1", 0)
+        hdr = {"Authorization": "Bearer xray-admin-token"}
+        client = None
+        try:
+            # --- canary first: ZERO foreground traffic ------------------
+            before = registry.histogram_family_count("canary_probe_duration")
+            garages[0].config.admin.canary_interval_secs = 0.1
+            garages[0].config.admin.canary_object_bytes = 70_000  # 2 blocks
+            w = garages[0].spawn_canary(ep)
+            for _ in range(600):
+                await asyncio.sleep(0.05)
+                if w.probes >= 1:
+                    break
+            assert w.probes >= 1 and w.failed == 0, w.status()
+            assert (
+                registry.histogram_family_count("canary_probe_duration")
+                >= before + 3
+            )
+            dig = DigestCollector(garages[0]).collect()
+            assert dig["canary"]["ops"] >= 3 and dig["canary"]["err"] == 0
+
+            # --- foreground PUTs through the real S3 API ----------------
+            key = await garages[0].helper.create_key("xray")
+            key.params().allow_create_bucket.update(True)
+            await garages[0].key_table.insert(key)
+            client = S3Client(ep, key.key_id, key.secret())
+            await client.create_bucket("accept")
+            aggregator.reset()
+            body = os.urandom(3 * 65536)  # 3 blocks per object
+            for i in range(8):
+                await client.put_object("accept", f"o{i}", body)
+            assert await client.get_object("accept", "o0") == body
+
+            # --- the waterfall endpoint ---------------------------------
+            import aiohttp
+
+            port = admin.runner.addresses[0][1]
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/v1/debug/latency", headers=hdr
+                ) as resp:
+                    assert resp.status == 200
+                    lat = await resp.json()
+            assert lat["enabled"]
+            assert lat["phases"] == list(PHASES)
+            put = lat["ops"]["put"]
+            assert put["count"] >= 8
+            # ACCEPTANCE: >= 80% of PUT wall time attributed to named
+            # phases, overlap efficiency reported
+            assert put["coverage"] >= 0.8, put
+            assert put["overlapEfficiency"] > 0, put
+            # the EC write pipeline's stages are all visible
+            assert {"encode", "fanout", "chunk", "meta_commit"} <= set(
+                put["phases"]
+            ), put["phases"].keys()
+            get = lat["ops"]["get"]
+            assert {"piece_fetch", "decode"} <= set(get["phases"])
+
+            # phase histograms exported, all labels in the catalogue
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/metrics", headers=hdr
+                ) as resp:
+                    text = await resp.text()
+            assert "api_s3_phase_duration_bucket" in text
+            assert "api_s3_overlap_efficiency" in text
+            for m in re.finditer(
+                r'api_s3_phase_duration_count\{op="([^"]+)",phase="([^"]+)"\}',
+                text,
+            ):
+                assert m.group(1) in OPS and m.group(2) in PHASES, m.group(0)
+        finally:
+            await admin.stop()
+            await stop_cluster(
+                garages, [s3], [client] if client is not None else []
+            )
+
+    run(main())

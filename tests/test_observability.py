@@ -149,6 +149,32 @@ def test_metrics_exposition_lint(tmp_path):
             assert types.get("event_loop_lag_seconds") == "histogram"
             assert "event_loop_lag_seconds_bucket" in text
             assert "event_loop_lag_seconds_sum" in text
+
+            # latency-X-ray phase cardinality: every {op,phase} label
+            # combination of api_s3_phase_duration comes from the fixed
+            # catalogue (utils/latency.py) — an ad-hoc span name leaking
+            # into the label space is a lint failure, not a new series
+            import re as _re
+
+            from garage_tpu.utils.latency import OPS, PHASES
+
+            assert types.get("api_s3_phase_duration") == "histogram"
+            combos = set(
+                _re.findall(
+                    r'api_s3_phase_duration_count\{op="([^"]+)",'
+                    r'phase="([^"]+)"\}',
+                    text,
+                )
+            )
+            assert combos, "no phase samples from the PUT/GET above"
+            for op, phase in combos:
+                assert op in OPS, f"op {op!r} outside the catalogue"
+                assert phase in PHASES, f"phase {phase!r} outside the catalogue"
+            # overlap-efficiency gauge rides along, op-labelled only
+            for m in _re.finditer(
+                r'api_s3_overlap_efficiency\{op="([^"]+)"\}', text
+            ):
+                assert m.group(1) in OPS
         finally:
             await admin.stop()
             await teardown(garage, s3)
