@@ -1,0 +1,23 @@
+"""graft-lint: the async-hazard and invariant static-analysis plane.
+
+Pure-stdlib (`ast` only — the container has no ruff/mypy) analyzer that
+mechanically enforces the invariants the repo keeps re-learning by hand:
+
+  loop-blocker         blocking syscalls reachable from a coroutine stall
+                       the event loop for EVERY concurrent request
+  orphan-task          a fire-and-forget create_task drops exceptions on
+                       the floor and may be garbage-collected mid-flight
+  swallowed-exception  `except Exception` bodies must log, re-raise,
+                       count a metric, or carry an explicit pragma
+  resource-discipline  metric families registered by an instance must be
+                       unregistered by it; config knobs read anywhere
+                       must be declared (and so validated) at load time
+
+Run via ``script/graft_lint.py`` (tier-1 gated by
+``tests/test_graft_lint.py`` against ``script/lint_baseline.json``).
+Rule catalogue and pragma syntax: doc/static-analysis.md.
+"""
+
+from .core import Project, Violation, analyze  # noqa: F401
+
+__all__ = ["Project", "Violation", "analyze"]

@@ -59,17 +59,34 @@ PIECE_MAGIC_V1 = b"GTP1"
 PIECE_MAGIC = b"GTP2"
 
 
+def _read_file_sync(path: str) -> bytes:
+    """Whole-file read — always call through asyncio.to_thread from
+    coroutines (graft-lint loop-blocker): a disk read on the event loop
+    stalls EVERY concurrent request on the node."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
 def _file_stream(path: str, chunk: int = 256 * 1024):
     """Async generator reading a block file in chunks (serving side of
-    streamed Get: no whole-file buffer)."""
+    streamed Get: no whole-file buffer).  Each read runs in a worker
+    thread so a slow/contended disk never blocks the event loop between
+    chunks."""
 
     async def gen():
-        with open(path, "rb") as f:
+        f = await asyncio.to_thread(open, path, "rb")
+        try:
             while True:
-                b = f.read(chunk)
+                b = await asyncio.to_thread(f.read, chunk)
                 if not b:
                     return
                 yield b
+        finally:
+            # close in a thread too: after a cancelled read, close()
+            # blocks on the BufferedReader lock until the in-flight disk
+            # read finishes — on the loop that would be exactly the stall
+            # this function exists to avoid
+            await asyncio.to_thread(f.close)
 
     return gen()
 
@@ -308,20 +325,34 @@ class BlockManager:
                     return  # already have an equal-or-better copy
             base = self.data_layout.primary_dir(hash32)
             d = self.data_layout.block_dir(base, hash32)
-            os.makedirs(d, exist_ok=True)
             path = os.path.join(d, self._file_name(hash32, piece, compressed))
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(stored)
-                if self.data_fsync:
-                    f.flush()
-                    os.fsync(f.fileno())
-            os.replace(tmp, path)
+            # the mkdir/write/fsync/rename sequence runs in a worker
+            # thread: with data_fsync on, an fsync on the loop thread
+            # used to stall every concurrent request for the duration of
+            # a disk flush (the single biggest per-request event-loop
+            # blocker on the EC PUT path).  The per-prefix lock is held
+            # across the await, so write serialization per hash shard is
+            # unchanged.
+            await asyncio.to_thread(
+                self._write_block_file_sync, d, path, stored
+            )
             if existing is not None and existing[0] != path:
                 try:
-                    os.remove(existing[0])
+                    await asyncio.to_thread(os.remove, existing[0])
                 except OSError:
                     pass
+
+    def _write_block_file_sync(self, d: str, path: str, stored: bytes) -> None:
+        """Blocking half of write_block_local — runs via
+        asyncio.to_thread, never call from a coroutine directly."""
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(stored)
+            if self.data_fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     async def read_block_local(self, hash32: bytes) -> bytes | None:
         """Read + verify + decompress the locally stored piece/block."""
@@ -339,8 +370,7 @@ class BlockManager:
             self.resync.queue_block(hash32)
             return None
         path, compressed = found
-        with open(path, "rb") as f:
-            stored = f.read()
+        stored = await asyncio.to_thread(_read_file_sync, path)
         try:
             data = zstandard.decompress(stored) if compressed else stored
         except zstandard.ZstdError as e:
@@ -368,7 +398,7 @@ class BlockManager:
 
         registry.incr("block_corrupted_count")
         try:
-            os.replace(path, path + ".corrupted")
+            await asyncio.to_thread(os.replace, path, path + ".corrupted")
         except OSError:
             pass
 
@@ -440,7 +470,7 @@ class BlockManager:
                         continue  # legacy .zst replica file: size lies
                     from .repair_plan import _stored_piece_len
 
-                    plen = _stored_piece_len(path)
+                    plen = await asyncio.to_thread(_stored_piece_len, path)
                     break
                 out.append([sorted(pieces.keys()), plen])
             return Resp(out)
@@ -693,8 +723,7 @@ class BlockManager:
             found = self.find_block_file(hash32, piece=piece)
             if found is None:
                 raise Error("piece not local")
-            with open(found[0], "rb") as f:
-                stored = f.read()
+            stored = await asyncio.to_thread(_read_file_sync, found[0])
             if found[1]:
                 stored = zstandard.decompress(stored)
             return unwrap_piece(stored)

@@ -14,6 +14,7 @@ RebalanceWorker — move block files to their new primary directory after a
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 
@@ -191,15 +192,14 @@ class ScrubWorker(Worker):
         so a scrub pass over thousands of shards is a few dispatches."""
         import numpy as np
 
-        from .manager import piece_hash, stored_piece_parts
+        from .manager import _read_file_sync, piece_hash, stored_piece_parts
 
         mgr = self.manager
         groups: dict[int, list[tuple[bytes, int, str, bytes, bytes]]] = {}
         for h in hashes:
             for pi, (path, compressed) in mgr.local_pieces(h).items():
                 try:
-                    with open(path, "rb") as f:
-                        stored = f.read()
+                    stored = await asyncio.to_thread(_read_file_sync, path)
                 except OSError:
                     continue
                 parts = stored_piece_parts(stored)
@@ -219,7 +219,8 @@ class ScrubWorker(Worker):
                     from ..ops.hash_tpu import blake3_batch as jax_batch
 
                     got = jax_batch(batch)
-                except Exception:  # noqa: BLE001 — unsupported shape/backend
+                except Exception as e:  # noqa: BLE001 — unsupported shape/backend
+                    logger.debug("scrub: jax batch hash fell back: %r", e)
                     got = None
                 if got is None:
                     from .. import _native
@@ -268,8 +269,8 @@ class RebalanceWorker(Worker):
             for piece, (path, compressed) in mgr.local_pieces(key).items():
                 want = os.path.join(want_dir, mgr._file_name(key, piece, compressed))
                 if path != want:
-                    os.makedirs(want_dir, exist_ok=True)
-                    os.replace(path, want)
+                    await asyncio.to_thread(os.makedirs, want_dir, exist_ok=True)
+                    await asyncio.to_thread(os.replace, path, want)
                     self.moved += 1
             if n >= 100:
                 return WorkerState.BUSY

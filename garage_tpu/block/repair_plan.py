@@ -61,6 +61,7 @@ Metric families (catalogued in doc/monitoring.md, rendered by the admin
 
 from __future__ import annotations
 
+import asyncio
 import itertools
 import logging
 import os
@@ -199,7 +200,8 @@ def _mesh_width(manager) -> int:
         return 1
     try:
         return max(1, tpu._mesh_width())
-    except Exception:  # noqa: BLE001 — planner must not die on telemetry
+    except Exception as e:  # noqa: BLE001 — planner must not die on telemetry
+        logger.debug("mesh width probe failed, assuming 1: %r", e)
         return 1
 
 
@@ -290,7 +292,8 @@ class RepairPlanner(Worker):
             plan = Persister(
                 metadata_dir, "repair_plan", PlanPersisted
             ).load()
-        except Exception:  # noqa: BLE001
+        except Exception as e:  # noqa: BLE001
+            logger.warning("unreadable repair_plan checkpoint ignored: %r", e)
             return False
         return plan is not None and plan.state in ("scanning", "repairing")
 
@@ -442,7 +445,7 @@ class RepairPlanner(Worker):
             for _pi, (path, compressed) in sorted(local.items()):
                 if compressed:
                     continue  # legacy .zst replica file: size lies
-                plen[h] = _stored_piece_len(path)
+                plen[h] = await asyncio.to_thread(_stored_piece_len, path)
                 break
             # survey EVERY node that may hold pieces — the union of all
             # active layout versions (storage_nodes_of), not just the

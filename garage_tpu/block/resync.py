@@ -152,7 +152,7 @@ class BlockResyncManager:
                 # block deleted: reclaim every local piece
                 for _pi, (path, _c) in local.items():
                     try:
-                        os.remove(path)
+                        await asyncio.to_thread(os.remove, path)
                     except OSError:
                         pass
                 mgr.rc.clear_deleted(hash32)
@@ -174,7 +174,7 @@ class BlockResyncManager:
                 if len(distinct) >= mgr.codec.min_pieces:
                     for _pi, (path, _c) in local.items():
                         try:
-                            os.remove(path)
+                            await asyncio.to_thread(os.remove, path)
                         except OSError:
                             pass
                 else:
@@ -211,9 +211,12 @@ class BlockResyncManager:
                         if found:
                             from ..net.stream import bytes_stream
 
+                            from .manager import _read_file_sync
+
                             path, compressed = found
-                            with open(path, "rb") as f:
-                                stored = f.read()
+                            stored = await asyncio.to_thread(
+                                _read_file_sync, path
+                            )
                             async with mgr.buffers.reserve(len(stored)):
                                 # content-addressed Put: safe to retry
                                 await mgr.helper.call(
@@ -232,7 +235,7 @@ class BlockResyncManager:
             found = mgr.find_block_file(hash32)
             if found:
                 try:
-                    os.remove(found[0])
+                    await asyncio.to_thread(os.remove, found[0])
                     logger.debug("resync: deleted %s", hash32.hex()[:16])
                 except OSError:
                     pass

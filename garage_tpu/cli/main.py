@@ -387,6 +387,7 @@ async def run_cli(args) -> None:
         # local: read the node key from metadata_dir
         from ..net.handshake import node_id_of
 
+        # graft-lint: allow-blocking(one-shot CLI command, loop not shared)
         with open(os.path.join(config.metadata_dir, "node_key"), "rb") as f:
             nid = node_id_of(f.read())
         addr = config.rpc_public_addr or config.rpc_bind_addr
@@ -805,6 +806,7 @@ async def dispatch(args, call, config) -> str | None:
                 json.dumps(r["speedscope"]) if args.speedscope else r["folded"]
             )
             if args.output:
+                # graft-lint: allow-blocking(one-shot CLI command, loop not shared)
                 with open(args.output, "w") as f:
                     f.write(body)
                 return (

@@ -86,6 +86,7 @@ def _read_value(spec: str) -> bytes:
     if spec == "-":
         return sys.stdin.buffer.read()
     if spec.startswith("@"):
+        # graft-lint: allow-blocking(one-shot CLI client, loop not shared)
         with open(spec[1:], "rb") as f:
             return f.read()
     return spec.encode()

@@ -20,6 +20,7 @@ import asyncio
 import logging
 
 from ...net.message import PRIO_HIGH, PRIO_NORMAL, Req, Resp
+from ...utils.aio import reap
 from ...utils.error import Error
 from .item_table import CausalContext, K2VItem
 from .seen import RangeSeenMarker
@@ -189,9 +190,10 @@ class K2VRpcHandler:
                 if errs > len(nodes) - quorum:
                     raise Error(f"poll_item: {errs} replicas failed")
         finally:
-            for t in tasks:
-                if not t.done():
-                    t.cancel()
+            # cancel stragglers AND consume every outcome: a replica that
+            # failed between our last wait and the cancel would otherwise
+            # leak an unretrieved exception (graft-lint orphan-task triage)
+            await reap(tasks, log=logger, what="poll_item rpc")
         if oks < quorum:
             # silently-hanging replicas count against quorum too: a
             # sub-quorum answer (or timeout) must not masquerade as an
@@ -267,9 +269,7 @@ class K2VRpcHandler:
                         deadline, loop.time() + POLL_RANGE_EXTRA_DELAY
                     )
         finally:
-            for t in tasks:
-                if not t.done():
-                    t.cancel()
+            await reap(tasks, log=logger, what="poll_range rpc")
         if len(resps) < quorum:
             # errored AND silently-hanging replicas both count against the
             # read quorum — advancing the seen marker off a sub-quorum view

@@ -101,7 +101,11 @@ class LifecycleWorker(Worker):
             try:
                 b = await self.garage.helper.get_bucket(bucket_id)
                 self._bucket_cache[bucket_id] = b.params().lifecycle.get()
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "lifecycle: cannot read bucket %s config, skipping: %r",
+                    bucket_id.hex()[:16], e,
+                )
                 self._bucket_cache[bucket_id] = None
         return self._bucket_cache[bucket_id]
 

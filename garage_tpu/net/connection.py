@@ -320,8 +320,10 @@ class Connection:
                     # producer generator + its credit entry
                     try:
                         await out.frames.aclose()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001
+                        logger.debug(
+                            "closing aborted stream rid %d: %r", out.rid, e
+                        )
                     if out.owns_credit:
                         self._out_credit.pop(out.rid, None)
                         self._active_out.pop(out.rid, None)
@@ -604,22 +606,21 @@ class Connection:
         self._send_wakeup.set()
         try:
             self.box.writer.close()
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001
+            logger.debug("transport close during teardown: %r", e)
         if self.on_close:
             self.on_close(self)
 
     async def close(self) -> None:
+        from ..utils.aio import reap
+
         for t in self._tasks:
             t.cancel()
         await self._teardown()
-        cur = asyncio.current_task()
-        for t in self._tasks:
-            if t is not cur:
-                try:
-                    await t
-                except (asyncio.CancelledError, Exception):
-                    pass
+        # drain the send/recv loops, consuming their outcomes (a loop
+        # that died of a real error logs it at debug instead of leaking
+        # an unretrieved-exception warning)
+        await reap(self._tasks, log=logger, what="connection loop")
 
 
 async def _one_frame(kind, flags, rid, payload):

@@ -225,8 +225,13 @@ class NetApp:
         conn.start()
         if old is not None:
             # displaced by a reconnect or simultaneous dial: close the old
-            # connection so its socket and tasks don't leak
-            asyncio.create_task(old.close())
+            # connection so its socket and tasks don't leak (supervised —
+            # a failed close would otherwise vanish with the task handle)
+            from ..utils.aio import spawn_supervised
+
+            spawn_supervised(
+                old.close(), name=f"conn-close-{conn.peer_id.hex()[:8]}"
+            )
 
     def _on_conn_close(self, conn: Connection) -> None:
         self.all_conns.discard(conn)

@@ -14,6 +14,7 @@ import random
 import time
 from dataclasses import dataclass, field
 
+from ..utils.aio import spawn_supervised
 from ..utils.backoff import expo, jittered
 from .message import PRIO_HIGH, Req, Resp
 from .netapp import NetApp
@@ -85,7 +86,7 @@ class PeeringManager:
         self._task: asyncio.Task | None = None
 
     def start(self) -> None:
-        self._task = asyncio.create_task(self._loop())
+        self._task = spawn_supervised(self._loop(), name="peering-loop")
 
     async def stop(self) -> None:
         if self._task:
@@ -156,10 +157,16 @@ class PeeringManager:
                 ):
                     interval = SICK_PING_INTERVAL
                 if now - p.last_seen >= interval:
-                    asyncio.create_task(self._ping(p))
+                    # supervised: a crashed ping task must be logged, not
+                    # silently dropped with the peer stuck "up" forever
+                    spawn_supervised(
+                        self._ping(p), name=f"ping-{p.id.hex()[:8]}"
+                    )
             elif p.addr and now >= p.next_retry:
                 p.state = "connecting"
-                asyncio.create_task(self._try_connect(p))
+                spawn_supervised(
+                    self._try_connect(p), name=f"connect-{p.id.hex()[:8]}"
+                )
 
     async def _ping(self, p: PeerInfo) -> None:
         if p.ping_inflight:

@@ -52,6 +52,7 @@ def resolved_platform(pin: str | None = None) -> str:
         import jax
 
         return jax.default_backend()
+    # graft-lint: allow-swallow(best-effort backend probe; "unknown" is a valid answer)
     except Exception:  # noqa: BLE001
         return "unknown"
 

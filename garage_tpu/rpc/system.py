@@ -168,18 +168,16 @@ class System:
         self._tasks.append(asyncio.create_task(self._advertise_loop()))
 
     async def stop(self) -> None:
-        for t in self._tasks:
-            t.cancel()
-        for t in self._tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+        from ..utils.aio import reap
+
+        await reap(self._tasks, log=logger, what="system loop")
         for d in self.discovery:
             try:
                 await d.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                logger.debug(
+                    "discovery %s close failed: %r", type(d).__name__, e
+                )
         await self.peering.stop()
 
     # --- status --------------------------------------------------------------
@@ -310,8 +308,11 @@ class System:
                     pid, st, prio=PRIO_HIGH, timeout=10.0
                 )
                 self._record_status(pid, NodeStatus.from_obj(resp.body))
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 — one dead peer must not
+                # stall the wave, but the miss is worth a debug line
+                logger.debug(
+                    "status exchange with %s failed: %r", pid.hex()[:8], e
+                )
 
         # concurrent fan-out: one hung peer must not delay the rest
         await asyncio.gather(

@@ -31,12 +31,15 @@ entry out — there is no stale-gauge unregistration to forget.
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import deque
 from statistics import median
 from typing import Any
 
 from ..utils import metrics as metrics_mod
+
+logger = logging.getLogger("garage.telemetry")
 
 DIGEST_VERSION = 1
 
@@ -452,7 +455,8 @@ def outlier_node_ids(system) -> list[str]:
                 {"id": pid.hex(), "digest": _valid_digest(pst.telemetry)}
             )
         return sorted(detect_outliers(rows))
-    except Exception:  # noqa: BLE001 — health() must never fail on telemetry
+    except Exception as e:  # noqa: BLE001 — health() must never fail on telemetry
+        logger.debug("outlier computation failed: %r", e)
         return []
 
 

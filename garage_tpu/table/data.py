@@ -170,8 +170,9 @@ class TableData:
         for fn in self.change_waiters:
             try:
                 fn()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — one broken waiter must not
+                # starve the rest, but a raising callback is a real bug
+                logger.exception("table change waiter failed")
 
 
 def _prefix_end(prefix: bytes) -> bytes | None:

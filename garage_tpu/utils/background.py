@@ -38,17 +38,13 @@ _STATE_NUM = {"idle": 0, "busy": 1, "throttled": 2, "done": 3}
 # worker families).
 _gauge_ids = itertools.count(1)
 
-# The event loop only keeps weak references to tasks; fire-and-forget tasks
-# must be anchored somewhere or they can be garbage-collected mid-flight.
-_background_tasks: set[asyncio.Task] = set()
-
-
 def spawn(coro, name: str | None = None) -> asyncio.Task:
-    """create_task with a strong reference held until completion."""
-    t = asyncio.create_task(coro, name=name)
-    _background_tasks.add(t)
-    t.add_done_callback(_background_tasks.discard)
-    return t
+    """create_task with a strong reference held until completion and
+    crash logging — delegates to the shared supervised-spawn registry
+    (utils/aio.py), kept as an alias for its existing call sites."""
+    from .aio import spawn_supervised
+
+    return spawn_supervised(coro, name=name)
 
 
 class WorkerState(enum.Enum):

@@ -116,6 +116,7 @@ def enable_persistent_cache(path: str | None = None) -> str:
             "jax_persistent_cache_enable_xla_caches",
             "all",
         )
+    # graft-lint: allow-swallow(older jax lacks the flag; core cache still works)
     except Exception:  # older jax: flag absent — core cache still works
         pass
     _enabled = True

@@ -98,6 +98,7 @@ def _all_tasks(loop) -> set:
             return asyncio.all_tasks(loop)
         except RuntimeError:
             continue
+        # graft-lint: allow-swallow(diagnostics must never raise; sampler gives up quietly)
         except Exception:  # noqa: BLE001 — diagnostics must never raise
             break
     return set()
@@ -133,6 +134,7 @@ def _task_trace_id(task) -> str:
                     if isinstance(s, Span):
                         return s.trace_id.hex()
         return ""
+    # graft-lint: allow-swallow(best-effort trace-id recovery from frame locals)
     except Exception:  # noqa: BLE001
         return ""
 
@@ -226,6 +228,7 @@ class SamplingProfiler:
         for task in _all_tasks(self.loop):
             try:
                 frames = _task_frames(task)
+            # graft-lint: allow-swallow(profiler samples at ~100 Hz; a vanished task is not news)
             except Exception:  # noqa: BLE001
                 continue
             if not frames:
@@ -367,6 +370,7 @@ class EventLoopWatchdog:
                     + (f" trace={tid}" if tid else "")
                     + f": {where}"
                 )
+            # graft-lint: allow-swallow(task-dump is best-effort diagnostics mid-stall)
             except Exception:  # noqa: BLE001
                 continue
         logger.warning("%s", "\n".join(parts))
@@ -460,6 +464,7 @@ class SlowRequestRecorder:
             waterfall = critical_path(root, tree)
             if not waterfall["phases"]:
                 waterfall = None
+        # graft-lint: allow-swallow(waterfall is an optional enrichment of the slow record)
         except Exception:  # noqa: BLE001 — diagnostics must never raise
             waterfall = None
         self.records.append(

@@ -110,6 +110,7 @@ class Metrics:
                 continue
             try:
                 total += float(fn())
+            # graft-lint: allow-swallow(a raising gauge fn means "no sample"; logging per scrape would spam)
             except Exception:  # noqa: BLE001
                 continue
         return total
@@ -226,6 +227,7 @@ class Metrics:
         for (name, labels), fn in self._gauge_fns.items():
             try:
                 gauges[(name, labels)] = float(fn())
+            # graft-lint: allow-swallow(a raising gauge fn means "no sample"; logging per scrape would spam)
             except Exception:  # noqa: BLE001 — a dead gauge must not kill scrape
                 continue
         last = None

@@ -141,11 +141,9 @@ class Tracer:
 
     async def stop(self) -> None:
         if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+            from .aio import reap
+
+            await reap([self._task], log=logger, what="trace flusher")
             self._task = None
         await self._flush()
         if self._session is not None:

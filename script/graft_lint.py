@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""graft-lint CLI: run the async-hazard/invariant analyzer over the repo.
+
+    python script/graft_lint.py                      # lint garage_tpu/
+    python script/graft_lint.py garage_tpu/block     # lint a subtree
+    python script/graft_lint.py --rules loop-blocker # one rule family
+    python script/graft_lint.py --write-baseline     # re-triage debt
+    python script/graft_lint.py --json               # machine-readable
+
+Exit codes: 0 clean (every finding is baselined), 1 new violations (or,
+with --strict, stale baseline entries), 2 usage error.
+
+The committed baseline (script/lint_baseline.json) is triaged debt:
+pre-existing findings stay visible there without failing the gate, new
+ones fail tier-1 via tests/test_graft_lint.py.  Analyzer docs:
+doc/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from garage_tpu.analysis import analyze  # noqa: E402
+from garage_tpu.analysis.core import (  # noqa: E402
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(REPO, "script", "lint_baseline.json")
+DEFAULT_PATHS = ["garage_tpu"]
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs relative to the repo root "
+                         "(default: garage_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="triaged-baseline JSON (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignore the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule families (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail on stale baseline entries (debt that "
+                         "was paid but not re-triaged)")
+    args = ap.parse_args(argv)
+
+    rules = args.rules.split(",") if args.rules else None
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        violations = analyze(REPO, paths, rules)
+    except ValueError as e:
+        print(f"graft-lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, violations)
+        print(f"graft-lint: wrote {len(violations)} finding(s) to "
+              f"{os.path.relpath(args.baseline, REPO)}")
+        return 0
+
+    baseline: dict[str, int] = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            baseline = load_baseline(args.baseline)
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            # a mangled baseline is a usage error, not "new violations"
+            print(
+                f"graft-lint: unreadable baseline {args.baseline}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    new, stale = diff_baseline(violations, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "total": len(violations),
+            "new": [v.__dict__ | {"key": v.key} for v in new],
+            "baselined": len(violations) - len(new),
+            "stale_baseline_keys": stale,
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.render())
+        known = len(violations) - len(new)
+        if known:
+            print(f"graft-lint: {known} baselined finding(s) "
+                  "(triaged debt, see script/lint_baseline.json)")
+        for k in stale:
+            print(f"graft-lint: stale baseline entry (debt paid — "
+                  f"re-run --write-baseline): {k}")
+        if not new and not (stale and args.strict):
+            print(f"graft-lint: clean ({len(violations)} total, "
+                  f"{known} baselined, {len(stale)} stale)")
+
+    if new:
+        return 1
+    if stale and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,8 +13,73 @@
 #       the only cross-thread surface in the native code (everything else
 #       is called from the single asyncio thread).  Data races on the
 #       fd/seq counters fail the run.
+#
+#   ./script/sanitize-native.sh --asan   AddressSanitizer ONLY, kvlog
+#       smoke: build the native module with -fsanitize=address and run
+#       the group-commit protocol once (commits racing the flusher
+#       thread, a barrier, a compaction, reopen).  Fast enough for the
+#       slow-marked test in tests/test_db.py.
+#
+#   ./script/sanitize-native.sh --ubsan  Same smoke under
+#       -fsanitize=undefined only (signed overflow, misaligned loads in
+#       the frame parser).
 set -e
 cd "$(dirname "$0")/.."
+
+# --asan / --ubsan: single-sanitizer builds + the kvlog group-commit
+# smoke (mirrors --tsan's shape: one mode flag, one focused workload)
+if [ "$1" = "--asan" ] || [ "$1" = "--ubsan" ]; then
+    if [ "$1" = "--asan" ]; then
+        MODE=asan
+        SAN_FLAGS="-fsanitize=address"
+        RUNTIME=$(g++ -print-file-name=libasan.so)
+        export ASAN_OPTIONS=detect_leaks=0
+    else
+        MODE=ubsan
+        SAN_FLAGS="-fsanitize=undefined"
+        RUNTIME=$(g++ -print-file-name=libubsan.so)
+        export UBSAN_OPTIONS="halt_on_error=1 print_stacktrace=1"
+    fi
+    SMOKE_SO=/tmp/libgarage_native_${MODE}.so
+    g++ -g -O1 -pthread $SAN_FLAGS -fno-sanitize-recover=all \
+        -fno-omit-frame-pointer -shared -fPIC -std=c++17 -o "$SMOKE_SO" \
+        garage_tpu/_native/gf8.cpp garage_tpu/_native/blake3.cpp \
+        garage_tpu/_native/kvlog.cpp
+
+    export GARAGE_NATIVE_SO="$SMOKE_SO"
+    export LD_PRELOAD="$RUNTIME"
+    export JAX_PLATFORMS=cpu
+    unset PALLAS_AXON_POOL_IPS
+
+    python - <<EOF
+import os, tempfile
+
+from garage_tpu import _native
+from garage_tpu.db.native_engine import NativeDb, _CtypesBinding
+
+assert _native.available(), "$MODE library failed to load"
+binding = _CtypesBinding(_native.lib())
+tmp = tempfile.mkdtemp()
+
+# group-commit protocol, ONCE: the flusher thread syncs while this
+# thread commits, one explicit barrier, one forced compaction, reopen
+path = os.path.join(tmp, "smoke-group.log")
+db = NativeDb(path, fsync="group", binding=binding)
+t = db.open_tree("g")
+for i in range(2000):
+    t.insert(b"gk%04d" % (i % 256), os.urandom(64))
+db.sync_barrier()
+db.kv.compact(db.h)
+assert db.kv.sync_failures(db.h) == 0
+assert len(t) == 256
+db.close()
+db2 = NativeDb(path, fsync="group", binding=binding)
+assert len(db2.open_tree("g")) == 256
+db2.close()
+print("$MODE: kvlog group-commit smoke clean")
+EOF
+    exit 0
+fi
 
 if [ "$1" = "--tsan" ]; then
     TSAN_SO=/tmp/libgarage_native_tsan.so

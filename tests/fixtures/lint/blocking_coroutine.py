@@ -1,0 +1,30 @@
+"""Fixture: loop-blocker must fire on direct AND helper-propagated
+blocking calls (two levels), and honor the allow-blocking pragma."""
+
+import os
+import time
+
+
+async def direct_blocker(path):
+    with open(path, "rb") as f:  # direct: flagged
+        data = f.read()
+    os.fsync(3)  # direct: flagged
+    return data
+
+
+def _helper_level_two(path):
+    os.replace(path, path + ".bak")  # depth 2: flagged
+
+
+def _helper_level_one(path):
+    time.sleep(0.1)  # depth 1: flagged
+    _helper_level_two(path)
+
+
+async def indirect_blocker(path):
+    _helper_level_one(path)
+
+
+async def suppressed_blocker():
+    # graft-lint: allow-blocking(fixture proves suppression works)
+    time.sleep(0.0)

@@ -1,0 +1,10 @@
+"""Fixture: loop-blocker must follow `from . import mod` module bindings
+(`mod.helper()` calls) into the helper's file — regression for the
+resolution gap where `from . import x` mapped to the package directory
+instead of x's own module."""
+
+from . import helper_mod
+
+
+async def uses_module_helper(path):
+    helper_mod.flush_things(path)  # os.fsync inside: flagged via the chain

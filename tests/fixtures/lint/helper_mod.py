@@ -1,0 +1,10 @@
+"""Fixture companion to blocking_import_user.py: a sync helper module
+whose blocking call must be found through a `from . import helper_mod`
+module binding."""
+
+import os
+
+
+def flush_things(path):
+    os.fsync(3)  # flagged when reached from a coroutine in another module
+    return path

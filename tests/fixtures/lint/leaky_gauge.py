@@ -1,0 +1,21 @@
+"""Fixture: resource-discipline (metric-pair) must fire on a class that
+registers a gauge in its start path but has no unregister anywhere."""
+
+
+class LeakyWorker:
+    def spawn(self, registry):
+        registry.register_gauge(
+            "leaky_worker_gauge", (("id", "1"),), lambda: 1.0
+        )  # flagged: class never unregisters
+
+    def stop(self):
+        pass  # forgot unregister_gauge
+
+
+class PairedWorker:
+    def spawn(self, registry):
+        self._key = (("id", "2"),)
+        registry.register_gauge("paired_worker_gauge", self._key, lambda: 1.0)
+
+    def stop(self, registry):
+        registry.unregister_gauge("paired_worker_gauge", self._key)  # fine

@@ -1,0 +1,18 @@
+"""Fixture: orphan-task must fire on bare create_task/ensure_future
+statements and stay quiet when the handle is kept or the site carries
+the allow-orphan-task pragma."""
+
+import asyncio
+
+
+async def work():
+    pass
+
+
+async def spawner():
+    asyncio.create_task(work())  # orphan: flagged
+    asyncio.ensure_future(work())  # orphan: flagged
+    # graft-lint: allow-orphan-task(fixture proves suppression works)
+    asyncio.create_task(work())
+    kept = asyncio.create_task(work())  # stored: fine
+    await kept

@@ -1,0 +1,52 @@
+"""Fixture: swallowed-exception must fire on silent `except Exception`
+bodies and stay quiet for logged / re-raised / metric-counted / used /
+pragma'd handlers."""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def silent():
+    try:
+        1 / 0
+    except Exception:  # flagged: nothing escapes
+        pass
+
+
+def silent_tuple():
+    try:
+        1 / 0
+    except (ValueError, Exception):  # flagged: Exception hides in a tuple
+        return None
+
+
+def logged():
+    try:
+        1 / 0
+    except Exception as e:  # fine: logged
+        logger.warning("boom: %r", e)
+
+
+def reraised():
+    try:
+        1 / 0
+    except Exception:  # fine: re-raised
+        raise
+
+
+def used_as_data():
+    errors = []
+    try:
+        1 / 0
+    except Exception as e:  # fine: the exception flows onward
+        errors.append(repr(e))
+    return errors
+
+
+def pragmad():
+    try:
+        1 / 0
+    # graft-lint: allow-swallow(fixture proves suppression works)
+    except Exception:
+        pass

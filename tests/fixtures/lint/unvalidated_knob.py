@@ -1,0 +1,16 @@
+"""Fixture: resource-discipline (config-knob) must fire on a read of a
+[section] knob that is not declared on the section dataclass in
+utils/config.py (and hence bypasses load-time construction)."""
+
+
+def reads_bogus_knob(config):
+    return config.admin.totally_made_up_knob  # flagged: not declared
+
+
+def reads_declared_knob(config):
+    return config.admin.canary_interval_secs  # fine: declared field
+
+
+def unrelated_attribute(thing):
+    # receiver is not plainly a config object: must NOT be flagged
+    return thing.admin.totally_made_up_knob_elsewhere

@@ -1,0 +1,365 @@
+"""Tier-1 gate for graft-lint (ISSUE 7): the static-analysis plane.
+
+Three layers:
+
+  1. The GATE — the repo must be clean modulo the committed baseline
+     (`script/lint_baseline.json`), and the baseline itself must carry
+     no stale (already-paid) debt.  A new blocking call in a coroutine,
+     a fire-and-forget create_task, a silent `except Exception`, an
+     unpaired gauge, or an undeclared config-knob read fails here.
+  2. NEGATIVE FIXTURES — every rule family is proven to FIRE against
+     `tests/fixtures/lint/` (a rule that silently stopped matching
+     would otherwise look like a clean repo).
+  3. MECHANICS — baseline drift detection, pragma handling (including
+     bad pragmas), stdlib-only imports, CLI exit codes.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, REPO)
+
+from garage_tpu.analysis import analyze  # noqa: E402
+from garage_tpu.analysis.core import (  # noqa: E402
+    diff_baseline,
+    load_baseline,
+    write_baseline,
+)
+
+BASELINE = os.path.join(REPO, "script", "lint_baseline.json")
+FIXTURES = "tests/fixtures/lint"
+
+# the knob rule needs the section-dataclass inventory from config.py
+CONFIG = "garage_tpu/utils/config.py"
+
+
+def lint(*paths, rules=None):
+    return analyze(REPO, list(paths), rules)
+
+
+# --- 1. the gate --------------------------------------------------------------
+
+
+def test_repo_clean_modulo_baseline():
+    violations = lint("garage_tpu")
+    baseline = load_baseline(BASELINE)
+    new, stale = diff_baseline(violations, baseline)
+    assert not new, "NEW graft-lint violations (fix or triage via " \
+        "`python script/graft_lint.py --write-baseline`):\n" + "\n".join(
+            v.render() for v in new
+        )
+    assert not stale, (
+        "baseline carries PAID debt — regenerate with --write-baseline: "
+        f"{stale}"
+    )
+
+
+def test_loop_blocker_baseline_empty_on_data_plane():
+    """Acceptance: the data plane (block/, net/, api/) carries ZERO
+    triaged-but-unfixed loop blockers — every finding there was fixed,
+    not baselined."""
+    baseline = load_baseline(BASELINE)
+    offenders = [
+        k
+        for k in baseline
+        if k.startswith(
+            (
+                "loop-blocker:garage_tpu/block/",
+                "loop-blocker:garage_tpu/net/",
+                "loop-blocker:garage_tpu/api/",
+            )
+        )
+    ]
+    assert offenders == []
+
+
+def test_script_paths_also_clean():
+    # the lint/bench/dashboard gate scripts hold the repo to the same bar
+    violations = lint("script/graft_lint.py")
+    assert violations == []
+
+
+# --- 2. negative fixtures: every rule family fires ----------------------------
+
+
+def test_fixture_loop_blocker_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/blocking_coroutine.py")
+        if v.rule == "loop-blocker"
+    ]
+    by_symbol = {v.symbol for v in vs}
+    # direct blocking calls in the coroutine
+    assert "direct_blocker" in by_symbol
+    # propagated through TWO levels of sync helpers
+    assert "indirect_blocker" in by_symbol
+    details = " ".join(v.detail for v in vs)
+    assert "os.replace" in details  # the depth-2 call is attributed
+    # the pragma'd coroutine is suppressed
+    assert "suppressed_blocker" not in by_symbol
+    # both direct sites (open + fsync) and both propagated sites
+    assert len(vs) >= 4
+
+
+def test_fixture_loop_blocker_follows_module_imports():
+    """`from . import mod` bindings: `mod.helper()` chains resolve into
+    the helper's own file (regression — these used to map to the package
+    directory and silently drop the chain)."""
+    vs = [
+        v
+        for v in lint(
+            f"{FIXTURES}/blocking_import_user.py", f"{FIXTURES}/helper_mod.py"
+        )
+        if v.rule == "loop-blocker"
+    ]
+    assert len(vs) == 1
+    assert vs[0].symbol == "uses_module_helper"
+    assert vs[0].path.endswith("helper_mod.py")
+    assert "os.fsync" in vs[0].detail
+
+
+def test_fixture_orphan_task_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/orphan_task.py")
+        if v.rule == "orphan-task"
+    ]
+    assert len(vs) == 2  # create_task + ensure_future; pragma + stored fine
+    assert {v.symbol for v in vs} == {"spawner"}
+
+
+def test_fixture_swallowed_exception_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/silent_swallow.py")
+        if v.rule == "swallowed-exception"
+    ]
+    assert {v.symbol for v in vs} == {"silent", "silent_tuple"}
+
+
+def test_fixture_unpaired_gauge_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/leaky_gauge.py")
+        if v.rule == "resource-discipline"
+    ]
+    assert len(vs) == 1
+    assert vs[0].symbol == "LeakyWorker"
+    assert "leaky_worker_gauge" in vs[0].detail
+
+
+def test_fixture_unvalidated_knob_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/unvalidated_knob.py", CONFIG)
+        if v.rule == "resource-discipline"
+    ]
+    assert len(vs) == 1
+    assert "admin.totally_made_up_knob" in vs[0].detail
+    # declared knobs and non-config receivers stay quiet (asserted by
+    # the ==1 above: the fixture contains both)
+
+
+# --- 3. mechanics -------------------------------------------------------------
+
+
+def test_baseline_drift_new_violation_fails(tmp_path):
+    """A newly introduced violation must NOT be absorbed by the
+    baseline: simulate by baselining the current fixture findings, then
+    adding one more."""
+    vs = lint(f"{FIXTURES}/orphan_task.py")
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), vs)
+    baseline = load_baseline(str(bl))
+    # same findings: clean
+    new, stale = diff_baseline(vs, baseline)
+    assert not new and not stale
+    # one MORE occurrence of an existing key: caught
+    new, _ = diff_baseline(vs + [vs[0]], baseline)
+    assert len(new) == 1
+    # a paid-off finding: reported stale
+    _, stale = diff_baseline(vs[1:], baseline)
+    assert stale
+
+
+def test_fresh_violation_in_repo_tree_fails_gate(tmp_path):
+    """End-to-end drift: a tree that was clean gains a violation; the
+    CLI exits 1 against its previously-written baseline."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("async def f():\n    return 1\n")
+    bl = tmp_path / "bl.json"
+    vs = analyze(str(tmp_path), ["pkg"])
+    write_baseline(str(bl), vs)
+    (pkg / "bad.py").write_text(
+        "import time\n\nasync def g():\n    time.sleep(1)\n"
+    )
+    vs2 = analyze(str(tmp_path), ["pkg"])
+    new, _ = diff_baseline(vs2, load_baseline(str(bl)))
+    assert len(new) == 1 and new[0].rule == "loop-blocker"
+
+
+def test_bad_pragmas_are_violations(tmp_path):
+    (tmp_path / "p.py").write_text(
+        "import time\n"
+        "async def f():\n"
+        "    # graft-lint: allow-blocking()\n"
+        "    time.sleep(1)\n"
+        "def g():\n"
+        "    pass  # graft-lint: allow-everything(nope)\n"
+    )
+    vs = analyze(str(tmp_path), ["p.py"])
+    kinds = {v.detail for v in vs if v.rule == "pragma"}
+    assert "empty-reason:blocking" in kinds
+    # PRAGMA_RE captures the kind AFTER "allow-"
+    assert "unknown:everything" in kinds
+    # the empty-reason pragma still suppresses nothing extra to test
+    # here; the loop-blocker itself IS suppressed (reason quality is a
+    # separate, also-failing, finding)
+
+
+def test_pragma_in_string_does_not_suppress(tmp_path):
+    """Pragma text quoted in a string/docstring must NOT register a live
+    suppression (pragmas are comments, found via tokenize)."""
+    (tmp_path / "q.py").write_text(
+        "import time\n"
+        "async def f():\n"
+        '    x = "hint: # graft-lint: allow-blocking(quoted, not a pragma)"\n'
+        "    time.sleep(1)\n"
+        "    return x\n"
+    )
+    vs = analyze(str(tmp_path), ["q.py"])
+    assert [v.rule for v in vs] == ["loop-blocker"]
+
+
+def test_analyzer_imports_stdlib_only():
+    """Acceptance: the analyzer must run in the bare container — stdlib
+    imports only (plus intra-package relatives)."""
+    import sys as _sys
+
+    stdlib = set(_sys.stdlib_module_names)
+    adir = os.path.join(REPO, "garage_tpu", "analysis")
+    for name in sorted(os.listdir(adir)):
+        if not name.endswith(".py"):
+            continue
+        tree = ast.parse(open(os.path.join(adir, name)).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    root = a.name.split(".")[0]
+                    assert root in stdlib, f"{name}: non-stdlib import {a.name}"
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    continue  # relative: inside the package
+                root = (node.module or "").split(".")[0]
+                assert root in stdlib, f"{name}: non-stdlib import {node.module}"
+
+
+def test_cli_exit_codes():
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    # clean repo against the committed baseline -> 0
+    r = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True, cwd=REPO
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # fixtures without baseline -> 1, and findings are printed
+    r = subprocess.run(
+        [sys.executable, script, "--no-baseline",
+         f"{FIXTURES}/orphan_task.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 1
+    assert "orphan-task" in r.stdout
+    # JSON mode parses
+    r = subprocess.run(
+        [sys.executable, script, "--no-baseline", "--json",
+         f"{FIXTURES}/orphan_task.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 1
+    assert len(json.loads(r.stdout)["new"]) == 2
+
+
+def test_reap_propagates_caller_cancellation():
+    """reap() must not eat a cancel aimed at the CALLING coroutine: a
+    k2v long-poll cancelled while its finally-block reaps stragglers
+    has to end cancelled, not resume and complete (regression for the
+    per-task `except CancelledError: pass` drain)."""
+    import asyncio
+
+    from garage_tpu.utils.aio import reap
+
+    async def main():
+        entered = asyncio.Event()
+        started = asyncio.Event()
+        resumed = []
+
+        async def slow_straggler():
+            started.set()
+            try:
+                await asyncio.sleep(30)
+            except asyncio.CancelledError:
+                await asyncio.sleep(0.2)  # slow cancel teardown
+                raise
+
+        async def handler():
+            loop = asyncio.get_event_loop()
+            stragglers = [loop.create_task(slow_straggler())]
+            await started.wait()  # straggler is parked in its sleep
+            entered.set()
+            await reap(stragglers)  # outer cancel lands HERE, mid-drain
+            resumed.append(True)  # must NOT run after an outer cancel
+
+        h = asyncio.get_event_loop().create_task(handler())
+        await entered.wait()
+        await asyncio.sleep(0.05)  # reap is now awaiting the teardown
+        h.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await h
+        assert h.cancelled()
+        assert not resumed
+
+    asyncio.run(main())
+
+
+def test_supervised_spawn_logs_and_drains():
+    """The orphan-task remedy itself: spawn_supervised logs crashes via
+    the correlated logger and drops its strong reference afterwards."""
+    import asyncio
+    import logging
+
+    from garage_tpu.utils.aio import spawn_supervised, supervised_count
+
+    async def boom():
+        raise RuntimeError("kaboom")
+
+    async def ok():
+        return 42
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    async def main():
+        h = Capture()
+        logging.getLogger("garage.aio").addHandler(h)
+        try:
+            t1 = spawn_supervised(boom(), name="boom-task")
+            t2 = spawn_supervised(ok(), name="ok-task")
+            assert supervised_count() >= 2
+            await asyncio.gather(t1, t2, return_exceptions=True)
+            await asyncio.sleep(0)  # let done-callbacks run
+        finally:
+            logging.getLogger("garage.aio").removeHandler(h)
+        assert supervised_count() == 0
+        assert any(
+            "boom-task" in r.getMessage() and "kaboom" in r.getMessage()
+            for r in records
+        )
+        # the successful task logged nothing
+        assert not any("ok-task" in r.getMessage() for r in records)
+
+    asyncio.run(main())

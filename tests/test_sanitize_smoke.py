@@ -1,0 +1,27 @@
+"""Sanitizer smoke (ISSUE 7 satellite): build the native module under
+ASan / UBSan and run the kvlog group-commit protocol once through the
+real ctypes binding — memory errors and UB in the flusher/committer
+paths fail the run.  Slow-marked: each mode pays a full g++ rebuild."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+SCRIPT = os.path.join(REPO, "script", "sanitize-native.sh")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["--asan", "--ubsan"])
+def test_sanitized_kvlog_group_commit_smoke(mode):
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    r = subprocess.run(
+        [SCRIPT, mode], cwd=REPO, capture_output=True, text=True, timeout=600
+    )
+    assert r.returncode == 0, (
+        f"{mode} smoke failed (rc {r.returncode}):\n{r.stdout}\n{r.stderr}"
+    )
+    assert "group-commit smoke clean" in r.stdout
