@@ -168,6 +168,74 @@ async def run_bigget(tmp_path, size: int, depths: list[int]) -> dict:
         await stop_cluster(garages, [s3], [client])
 
 
+async def run_overload(
+    tmp_path, k: int, m: int, duration: float, slo_ms: float
+) -> dict:
+    """Overload mode (ISSUE 8 gate): 4x offered load against an
+    11-node EC(k,m) cluster with the admission controller + shedding
+    ladder live.  Measures what the overload-control plane promises:
+    the lowest offered tier sheds with 503 SlowDown, admitted
+    interactive p99 stays within the declared SLO, the ladder engages
+    and recovers, and the canary stays live throughout.  The scenario
+    itself lives in tests/overload_burst.py, shared with the slow
+    acceptance test so the two harnesses cannot drift."""
+    from overload_burst import (
+        MAX_IN_FLIGHT,
+        N_INTERACTIVE,
+        N_LISTERS,
+        N_WRITERS,
+        p99_ms,
+        run_overload_burst,
+    )
+    from test_ec_cluster import stop_cluster
+
+    garages, s3, booted_client = await boot_bench_cluster(
+        tmp_path, f"ec:{k}:{m}", n=k + m, block_size=65536
+    )
+    g0 = garages[0]
+    ep = booted_client.endpoint
+    clients = [booted_client]
+    try:
+        res = await run_overload_burst(g0, ep, duration=duration)
+        clients += res["clients"]
+        stats, canary = res["stats"], res["canary"]
+
+        def tier_out(kind):
+            s = stats[kind]
+            offered = s["ok"] + s["shed"]
+            return {
+                "ok": s["ok"],
+                "shed": s["shed"],
+                "shed_fraction": (
+                    round(s["shed"] / offered, 4) if offered else None
+                ),
+                "p99_ms": (
+                    round(p99_ms(s["times"]), 2) if s["times"] else None
+                ),
+            }
+
+        admitted_p99 = p99_ms(stats["interactive"]["times"])
+        return {
+            "offered_concurrency": N_INTERACTIVE + N_WRITERS + N_LISTERS,
+            "max_in_flight": MAX_IN_FLIGHT,
+            "duration_s": duration,
+            "slo_ms": slo_ms,
+            "admitted_p99_ms": (
+                round(admitted_p99, 2) if admitted_p99 else None
+            ),
+            "tiers": {t: tier_out(t) for t in stats},
+            "shed_fraction_lowest": tier_out("list")["shed_fraction"],
+            "ladder_max_level": res["max_level"],
+            "ladder_final_level": g0.shedder.level,
+            "ladder_steps_up": g0.shedder.steps_up,
+            "ladder_steps_down": g0.shedder.steps_down,
+            "canary_probes": canary.probes,
+            "canary_failed": canary.failed,
+        }
+    finally:
+        await stop_cluster(garages, [s3], clients)
+
+
 async def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--objects", type=int, default=200)
@@ -182,6 +250,17 @@ async def main() -> None:
     )
     ap.add_argument("--bigget", action="store_true")
     ap.add_argument("--big-size", type=int, default=100 * 1024 * 1024)
+    ap.add_argument(
+        "--overload", action="store_true",
+        help="overload-control gate: 4x burst against the EC cluster "
+        "with admission + shedding live (ISSUE 8)",
+    )
+    ap.add_argument("--duration", type=float, default=8.0,
+                    help="overload mode: burst length in seconds")
+    ap.add_argument(
+        "--slo-ms", type=float, default=2500.0,
+        help="overload mode: declared latency SLO for admitted traffic",
+    )
     ap.add_argument(
         "--concurrency",
         help="sweep mode (ROADMAP item 1 prerequisite): comma-separated "
@@ -223,6 +302,28 @@ async def main() -> None:
     if not m:
         raise SystemExit(f"bad --ec {args.ec!r}, want ec:k:m")
     k, mm = int(m.group(1)), int(m.group(2))
+
+    if args.overload:
+        with tempfile.TemporaryDirectory() as d:
+            detail = await run_overload(
+                pathlib.Path(d), k, mm, args.duration, args.slo_ms
+            )
+        p99 = detail["admitted_p99_ms"]
+        result = {
+            "metric": "s3_overload_graceful_degradation",
+            # <= 1.0 means admitted interactive p99 held the declared
+            # SLO while the burst was being shed
+            "value": round(p99 / args.slo_ms, 3) if p99 else None,
+            "unit": "admitted p99 / declared SLO",
+            "vs_baseline": round(args.slo_ms / p99, 3) if p99 else None,
+            "detail": {"geometry": args.ec, **detail},
+        }
+        line = json.dumps(result)
+        print(line)
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                f.write(line + "\n")
+        return
 
     def _ms_of(res: dict) -> dict:
         return {

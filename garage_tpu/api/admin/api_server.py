@@ -311,6 +311,12 @@ class AdminApiServer:
                     results.append({"success": False, "error": str(e)})
             return web.json_response(results)
 
+        if path == "/v1/overload" and request.method == "GET":
+            # overload-control plane (api/overload.py + rpc/shedding.py):
+            # admission counters per tier, tenant token levels, ladder
+            # level + applied rungs + hysteresis signals
+            return web.json_response(g.overload_status())
+
         if path == "/v1/repair/plan" and request.method == "GET":
             # repair plane (block/repair_plan.py): plan state, backlog by
             # urgency class, progress counters, admission-control knobs

@@ -77,6 +77,14 @@ class NotImplementedError_(ApiError):
     status = 501
 
 
+class SlowDown(ApiError):
+    """S3-semantic overload rejection (admission control,
+    api/overload.py): AWS SDKs back off and retry on this code."""
+
+    code = "SlowDown"
+    status = 503
+
+
 def error_xml(err: ApiError, resource: str = "", request_id: str = "") -> str:
     from xml.sax.saxutils import escape
 

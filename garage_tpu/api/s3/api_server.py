@@ -99,7 +99,44 @@ class S3ApiServer:
             return None
         return k.secret()
 
+    def _slow_down(self, request, ticket) -> web.Response:
+        """503 SlowDown with a Retry-After hint (api/overload.py shed
+        verdict).  Deliberately OUTSIDE request_metrics: an intentional
+        shed must not count as an S3 request or burn the availability
+        SLO budget (the shedding controller reads that budget — see
+        overload.py module docstring)."""
+        from ..common.error import SlowDown
+
+        err = SlowDown(ticket.reason or "please reduce your request rate")
+        return web.Response(
+            status=err.status,
+            text=error_xml(err, request.path),
+            content_type="application/xml",
+            headers={"Retry-After": str(max(1, int(ticket.retry_after)))},
+        )
+
     async def _entry(self, request: web.Request) -> web.StreamResponse:
+        # overload-control plane: admission happens FIRST, before any
+        # SigV4 work — the point is to turn excess load away at the
+        # cheapest possible place.  _entry is the single choke point.
+        ticket = None
+        ctl = getattr(self.garage, "overload", None)
+        if ctl is not None:
+            bucket_name, obj_key = self._parse_target(request)
+            ticket = await ctl.admit(request, bucket_name, obj_key)
+            if not ticket.admitted:
+                return self._slow_down(request, ticket)
+        try:
+            return await self._admitted_entry(
+                request, lead_secs=ticket.queued_secs if ticket else 0.0
+            )
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    async def _admitted_entry(
+        self, request: web.Request, lead_secs: float = 0.0
+    ) -> web.StreamResponse:
         from ...utils.metrics import registry, request_metrics
         from ...utils.tracing import tracer
 
@@ -126,7 +163,8 @@ class S3ApiServer:
 
         try:
             with request_metrics(
-                "api_s3", request.method, "api:s3", path=request.path
+                "api_s3", request.method, "api:s3",
+                lead_secs=lead_secs, path=request.path,
             ):
                 s = tracer.current()
                 trace_hex = s.trace_id.hex() if s is not None else None

@@ -99,6 +99,13 @@ class CanaryWorker(Worker):
                 break
         if key is None:
             key = await g.helper.create_key(CANARY_KEY_NAME)
+        # admission exemption (api/overload.py): the canary's probes must
+        # keep flowing at EVERY shedding-ladder level — shedding them
+        # would blind the exact signal the shedding controller uses to
+        # decide the node has recovered
+        ctl = getattr(g, "overload", None)
+        if ctl is not None:
+            ctl.exempt_key(key.key_id)
         try:
             bid = await g.helper.resolve_bucket(self.bucket)
         except Error:

@@ -23,6 +23,7 @@ import os
 from aiohttp import web
 
 from ...block.manager import INLINE_THRESHOLD
+from ...net.message import PRIO_HIGH
 from ...model.s3.block_ref_table import BlockRef
 from ...model.s3.object_table import Object, ObjectVersion
 from ...model.s3.version_table import Version
@@ -487,11 +488,17 @@ async def plain_block_stream(garage, blocks, start: int, end: int, enc_params):
     try:
         for i, (b_start, b_end, _h) in enumerate(wanted):
             while nxt < len(wanted) and nxt < i + GET_PREFETCH_DEPTH:
-                # tags allocate in spawn order == block order
+                # tags allocate in spawn order == block order.
+                # PRIO_HIGH: interactive GET is the top admission tier
+                # (api/overload.py), and its piece fetches must outrank
+                # PUT fan-out (PRIO_NORMAL) and background resync
+                # (PRIO_BACKGROUND) at the connection scheduler too —
+                # the RPC-level mirror of the HTTP priority classes
                 tasks.append(
                     asyncio.create_task(
                         bm.rpc_get_block(
-                            wanted[nxt][2], order_tag=tag_stream.order()
+                            wanted[nxt][2], prio=PRIO_HIGH,
+                            order_tag=tag_stream.order(),
                         )
                     )
                 )

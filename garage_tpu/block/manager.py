@@ -507,7 +507,12 @@ class BlockManager:
                 stored, compressed = self._maybe_compress(data)
             async with self.buffers.reserve(len(stored)):
                 # replica sends + their quorum wait are one awaited call;
-                # the whole window is attributed to the fan-out phase
+                # the whole window is attributed to the fan-out phase.
+                # prio audit (overload plane): foreground S3 PUT fan-out
+                # — PRIO_NORMAL by design, below interactive GET piece
+                # fetches (PRIO_HIGH, api/s3/objects.py) and above every
+                # background plane (PRIO_BACKGROUND: resync, repair,
+                # table sync)
                 with phase_span("fanout"):
                     await self.helper.try_write_many_sets(
                         self.endpoint,
@@ -565,6 +570,10 @@ class BlockManager:
                 # analyzer merges the parallel windows into one wall-
                 # clock fan-out interval
                 with phase_span("fanout"):
+                    # prio audit: EC PUT piece fan-out is foreground
+                    # S3-path work — PRIO_NORMAL, same class as the
+                    # replica fan-out above (interactive reads outrank
+                    # it at PRIO_HIGH; background planes sit below)
                     await self.helper.call(
                         self.endpoint, n,
                         ["Put", hash32,

@@ -611,6 +611,11 @@ class AdminRpcHandler:
             "telemetry": g.telemetry.collect(),
         }
 
+    async def op_overload_status(self, args) -> Any:
+        """Overload-control plane state (admission + shedding ladder) —
+        `cli overload status`."""
+        return self.garage.overload_status()
+
     async def op_cluster_telemetry(self, args) -> Any:
         """The cluster rollup (per-node digests + aggregates + outliers
         + SLO) over the admin mesh — `cluster top` / `cluster telemetry`."""

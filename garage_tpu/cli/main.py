@@ -159,6 +159,11 @@ def main(argv=None):
     )
     clu_sub.add_parser("telemetry", help="raw cluster rollup JSON")
 
+    ovl = sub.add_parser(
+        "overload", help="overload-control plane: admission + shedding ladder"
+    )
+    ovl.add_argument("overload_cmd", choices=["status"])
+
     wrk = sub.add_parser("worker")
     wrk.add_argument("worker_cmd", choices=["list", "get", "set"])
     wrk.add_argument("var", nargs="?")
@@ -459,6 +464,11 @@ def _render_cluster_top(r: dict) -> str:
             flags.append("OUTLIER")
         if not d:
             flags.append("no-digest")
+        # overload-control plane: a node above ladder level 0 is
+        # degrading background planes / shedding admission tiers
+        lvl = (d.get("ovl") or {}).get("lvl") or 0
+        if lvl:
+            flags.append(f"SHED-L{lvl}")
         # recency, not history: flag the LAST cycle's verdict — a single
         # transient failed leg must not mark a recovered node forever
         if cn.get("ok") == 0:
@@ -768,6 +778,41 @@ async def dispatch(args, call, config) -> str | None:
                      "allow_create_bucket": acb},
                 )
             )
+
+    if args.cmd == "overload" and args.overload_cmd == "status":
+        r = await call("overload-status")
+        if jd:
+            return jd(r)
+        adm = r.get("admission") or {}
+        rows = [
+            f"in flight\t{adm.get('inFlight')}/{adm.get('maxInFlight')}"
+            f" (queued {adm.get('queued')})",
+            f"shedding tiers\t{adm.get('shedFromTier') or '(none)'}",
+        ]
+        rows.append("tier\tadmitted\tqueued\tshed")
+        for tname, t in (adm.get("tiers") or {}).items():
+            rows.append(
+                f"{tname}\t{t['admitted']}\t{t['queued']}\t{t['shed']}"
+            )
+        lad = r.get("ladder")
+        if lad:
+            rows.append(
+                f"ladder level\t{lad['level']}/{lad['maxLevel']} "
+                f"(burn {lad['burnRate']:.2f}, "
+                f"lag p99 {lad['loopLagP99Ms']:.0f}ms)"
+            )
+            applied = [s["name"] for s in lad["ladder"] if s["applied"]]
+            rows.append(f"applied steps\t{', '.join(applied) or '(none)'}")
+            rows.append(
+                f"steps up/down\t{lad['stepsUp']}/{lad['stepsDown']}"
+            )
+            if lad.get("lastReason"):
+                rows.append(f"last change\t{lad['lastReason']}")
+        if adm.get("keyTokens"):
+            rows.append("key\ttokens left")
+            for k, v in adm["keyTokens"].items():
+                rows.append(f"{k}\t{v:g}")
+        return format_table(rows)
 
     if args.cmd == "worker" and args.worker_cmd == "get":
         return json.dumps(await call("worker-get", {"var": args.var}))

@@ -331,6 +331,21 @@ class Garage:
                 self.repair_params, "bytes_in_flight", max(1, int(v))
             ),
         )
+        # overload-control plane (api/overload.py + rpc/shedding.py):
+        # the admission controller exists from construction (the S3
+        # server reads it per request); the shedding controller spawns
+        # with the other workers
+        from ..api.overload import AdmissionController
+
+        self.overload = AdmissionController(config.overload)
+        self.shedder = None
+        self.bg_vars.register_rw(
+            "overload-max-in-flight",
+            lambda: str(self.config.overload.max_in_flight),
+            lambda v: setattr(
+                self.config.overload, "max_in_flight", max(1, int(v))
+            ),
+        )
         self.bg = BackgroundRunner()
         # flight recorder plane (utils/flight.py), wired in start()
         self.flight_recorder = None
@@ -448,6 +463,13 @@ class Garage:
             "cluster_connected_nodes", (),
             lambda: len(self.system.peering.connected_peers()),
         )
+        # overload-control plane: current degradation-ladder level (0 =
+        # healthy) and live in-flight admitted requests
+        reg(
+            "overload_ladder_level", (),
+            lambda: float(self.shedder.level if self.shedder else 0),
+        )
+        reg("api_in_flight_requests", (), lambda: float(self.overload.in_flight))
         # SLO error budgets (rpc/telemetry_digest.py SloTracker), scrape-
         # time so the rolling window advances even without digest traffic
         for kind in ("availability", "latency_p99"):
@@ -471,6 +493,14 @@ class Garage:
         self.bg.spawn(LifecycleWorker(self, metadata_dir=self.config.metadata_dir))
         if self.config.metadata_auto_snapshot_interval:
             self.bg.spawn(SnapshotWorker(self))
+        if self.config.overload.enabled:
+            # SLO-driven shedding controller (rpc/shedding.py): walks
+            # the degradation ladder off the local burn-rate/loop-lag
+            # signals, acting through the live BgVars + admission tiers
+            from ..rpc.shedding import SheddingController
+
+            self.shedder = SheddingController(self)
+            self.bg.spawn(self.shedder)
         # restart-safe repair plane: a plan checkpointed mid-flight by a
         # previous process resumes (ledger + cursor intact) instead of
         # rescanning the cluster
@@ -566,6 +596,18 @@ class Garage:
         }
         return out
 
+    def overload_status(self) -> dict:
+        """Admission + ladder state (admin GET /v1/overload, admin-RPC
+        `overload status`, `cli overload status`)."""
+        out = {
+            "node": self.node_id.hex(),
+            "admission": self.overload.status(),
+            "ladder": (
+                self.shedder.status_full() if self.shedder is not None else None
+            ),
+        }
+        return out
+
     async def stop(self) -> None:
         from ..utils.tracing import tracer
 
@@ -594,4 +636,5 @@ class Garage:
 
         for name, labels in getattr(self, "_gauge_keys", []):
             registry.unregister_gauge(name, labels)
+        self.overload.close()  # per-tenant token gauges
         self.db.close()

@@ -205,6 +205,15 @@ class DigestCollector:
         slo = getattr(g, "slo_tracker", None)
         if slo is not None:
             digest["slo"] = slo.digest_fields()
+        # overload-control plane (api/overload.py + rpc/shedding.py):
+        # ladder level + admission totals — a shedding node is visible
+        # cluster-wide ("ovl" keys are additive, DIGEST_VERSION stays 1)
+        ov = getattr(g, "overload", None)
+        if ov is not None:
+            ovl = ov.digest_fields()
+            sh = getattr(g, "shedder", None)
+            ovl["lvl"] = sh.level if sh is not None else 0
+            digest["ovl"] = ovl
         self._cached, self._cached_t = digest, now
         return digest
 
@@ -587,6 +596,12 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
      ("canary", "p99")),
     ("cluster_node_disk_avail_bytes", "free disk bytes (meta dir)",
      lambda row: (row.get("metaDiskAvail") or (None,))[0]),
+    ("cluster_node_overload_ladder_level",
+     "overload degradation-ladder level (0 = healthy)", ("ovl", "lvl")),
+    ("cluster_node_shed_requests", "cumulative admission-shed requests",
+     ("ovl", "shed")),
+    ("cluster_node_in_flight_requests", "admitted requests in flight",
+     ("ovl", "inf")),
 ]
 
 

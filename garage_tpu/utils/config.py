@@ -132,6 +132,44 @@ class RepairPlanConfig:
 
 
 @dataclass
+class OverloadConfig:
+    """Rebuild-specific: the overload-control plane (api/overload.py
+    admission controller + rpc/shedding.py SLO-driven shedding ladder).
+    Defaults are sized for a single node serving heavy mixed traffic;
+    `worker set overload-max-in-flight` tunes the cap live."""
+
+    enabled: bool = True
+    # global concurrency cap: requests processing at once on this node
+    max_in_flight: int = 256
+    # per-access-key token bucket (tokens/sec, burst ceiling)
+    key_rate: float = 200.0
+    key_burst: float = 400.0
+    # per-bucket token bucket — a bucket is a tenant surface too (many
+    # keys can hammer one bucket)
+    bucket_rate: float = 500.0
+    bucket_burst: float = 1000.0
+    # LRU bound on tracked tenants (keys + buckets each)
+    max_tracked_tenants: int = 1024
+    # top tier (interactive GET/HEAD) queues up to this long for
+    # capacity instead of shedding; bounded depth
+    queue_wait_msec: float = 2000.0
+    queue_depth: int = 64
+    # Retry-After hint on 503 SlowDown when no better estimate exists
+    shed_retry_after_secs: float = 2.0
+    # shedding controller (rpc/shedding.py): evaluation cadence and
+    # hysteresis thresholds on the max SLO burn rate / loop lag p99
+    check_interval_secs: float = 5.0
+    ladder_burn_up: float = 2.0  # step up while burn exceeds this
+    ladder_burn_down: float = 0.5  # recovery requires burn below this
+    loop_lag_p99_msec: float = 500.0  # or event-loop lag p99 over this
+    ladder_hold_secs: float = 30.0  # continuous recovery before a step down
+    # noise floor: the burn signal only counts once the SLO window holds
+    # at least this many requests — one 500 on an idle node must not
+    # walk the ladder (mirrors the outlier detector's eps floor)
+    min_window_requests: int = 100
+
+
+@dataclass
 class TpuConfig:
     """Rebuild-specific: the TPU compute plane used by the EC block codec and
     batched scrub hashing (no analog in the reference)."""
@@ -200,6 +238,7 @@ class Config:
     admin: AdminConfig = field(default_factory=AdminConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
     repair: RepairPlanConfig = field(default_factory=RepairPlanConfig)
+    overload: OverloadConfig = field(default_factory=OverloadConfig)
     consul_discovery: ConsulDiscoveryConfig | None = None
     kubernetes_discovery: KubernetesDiscoveryConfig | None = None
 
@@ -413,6 +452,8 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             cfg.tpu = TpuConfig(**_known(v, TpuConfig))
         elif k == "repair":
             cfg.repair = RepairPlanConfig(**_known(v, RepairPlanConfig))
+        elif k == "overload":
+            cfg.overload = OverloadConfig(**_known(v, OverloadConfig))
         elif k == "consul_discovery":
             cfg.consul_discovery = ConsulDiscoveryConfig(
                 **_known(v, ConsulDiscoveryConfig)
@@ -451,6 +492,32 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         raise ValueError("canary_object_bytes must be >= 1")
     if not str(cfg.admin.canary_bucket).strip():
         raise ValueError("canary_bucket must be a non-empty bucket name")
+    # overload knobs: refuse values that would wedge admission at load
+    # time (a zero rate admits nothing forever; inverted hysteresis
+    # thresholds would make the ladder oscillate by construction)
+    ov = cfg.overload
+    if int(ov.max_in_flight) < 1:
+        raise ValueError("overload.max_in_flight must be >= 1")
+    for knob in ("key_rate", "bucket_rate"):
+        if float(getattr(ov, knob)) <= 0:
+            raise ValueError(f"overload.{knob} must be > 0")
+    # a burst below 1 caps the bucket under one whole token: take(1)
+    # can never succeed and every tenant wedges permanently
+    for knob in ("key_burst", "bucket_burst"):
+        if float(getattr(ov, knob)) < 1:
+            raise ValueError(f"overload.{knob} must be >= 1")
+    if float(ov.queue_wait_msec) < 0 or int(ov.queue_depth) < 0:
+        raise ValueError("overload queue_wait_msec/queue_depth must be >= 0")
+    if not (0 <= float(ov.ladder_burn_down) < float(ov.ladder_burn_up)):
+        raise ValueError(
+            "overload.ladder_burn_down must be < ladder_burn_up (hysteresis)"
+        )
+    if float(ov.check_interval_secs) <= 0 or float(ov.ladder_hold_secs) <= 0:
+        raise ValueError(
+            "overload check_interval_secs/ladder_hold_secs must be > 0"
+        )
+    if float(ov.loop_lag_p99_msec) <= 0:
+        raise ValueError("overload.loop_lag_p99_msec must be > 0")
     # resolve secrets
     cfg.rpc_secret = _get_secret(
         cfg.rpc_secret,

@@ -75,8 +75,11 @@ class Metrics:
                 return
         d[2][-1] += 1
 
-    def timer(self, name: str, labels: tuple = ()):
-        return _Timer(self, name, labels)
+    def timer(self, name: str, labels: tuple = (), lead: float = 0.0):
+        """`lead` seconds are added to the observed duration — for time
+        the caller already spent on the request before the timer could
+        start (e.g. the admission queue wait ahead of request_metrics)."""
+        return _Timer(self, name, labels, lead)
 
     def set_gauge(self, name: str, labels: tuple, value: float) -> None:
         self.gauges[(name, labels)] = value
@@ -239,18 +242,30 @@ class Metrics:
         return lines
 
 
+def _esc(v) -> str:
+    """Prometheus label-value escaping.  Label values can carry
+    attacker-controlled strings (the admission plane's per-tenant
+    gauges use the pre-auth CLAIMED key id / URL bucket name): an
+    unescaped `"` or newline would corrupt the whole exposition and
+    make the node metrics-dark to the scraper."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
 def _fmt(labels: tuple) -> str:
     if not labels:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+    return "{" + ",".join(f'{k}="{_esc(v)}"' for k, v in labels) + "}"
 
 
 class _Timer:
-    def __init__(self, m: Metrics, name: str, labels: tuple):
+    def __init__(self, m: Metrics, name: str, labels: tuple, lead: float = 0.0):
         self.m, self.name, self.labels = m, name, labels
+        self.lead = lead
 
     def __enter__(self):
-        self.t0 = time.perf_counter()
+        self.t0 = time.perf_counter() - self.lead
         return self
 
     def __exit__(self, exc_type, exc, tb):
@@ -265,15 +280,20 @@ registry = Metrics()
 
 
 @_contextmanager
-def request_metrics(prefix: str, method: str, span_name: str, **span_attrs):
+def request_metrics(prefix: str, method: str, span_name: str,
+                    lead_secs: float = 0.0, **span_attrs):
     """Shared HTTP-frontend instrumentation: `<prefix>_request_counter`,
     `<prefix>_request_duration` histogram, and a root tracing span that
     parents the request's table/block sub-spans.  Used by the s3, k2v
-    and web servers so the pattern can't drift between them."""
+    and web servers so the pattern can't drift between them.
+    `lead_secs` back-dates the duration sample by time already spent on
+    the request before this wrapper ran (admission queue wait): the
+    histogram must report the latency the client saw, or queue buildup
+    is invisible to the latency-SLO burn signal."""
     from .tracing import span
 
     lbl = (("method", method),)
     registry.incr(f"{prefix}_request_counter", lbl)
     with span(span_name, method=method, **span_attrs):
-        with registry.timer(f"{prefix}_request_duration", lbl):
+        with registry.timer(f"{prefix}_request_duration", lbl, lead=lead_secs):
             yield

@@ -54,6 +54,19 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         # baseline); the floor trips if encode falls below ~3 GB/s
         ("parsed.vs_baseline", ">=", 0.3, "EC(8,3) encode GB/s vs baseline"),
     ],
+    "BENCH_s3_overload.json": [
+        # overload-control plane (ISSUE 8): 4x burst on 11-node EC(8,3)
+        # — measured 0.575 (admitted p99 1437 ms vs the 2500 ms SLO),
+        # list tier 99.8% shed, ladder 6 up / 6 down, canary 19/19
+        ("value", "<=", 1.0, "admitted interactive p99 within the SLO"),
+        ("detail.shed_fraction_lowest", ">=", 0.05,
+         "lowest tier actually sheds under the 4x burst"),
+        ("detail.ladder_max_level", ">=", 1, "shedding ladder engaged"),
+        ("detail.ladder_final_level", "<=", 0,
+         "ladder recovered to level 0 after the burst"),
+        ("detail.canary_failed", "<=", 0,
+         "canary probes stayed live through shedding"),
+    ],
 }
 
 
