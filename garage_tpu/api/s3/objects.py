@@ -27,6 +27,7 @@ from ...net.message import PRIO_HIGH
 from ...model.s3.block_ref_table import BlockRef
 from ...model.s3.object_table import Object, ObjectVersion
 from ...model.s3.version_table import Version
+from ...utils.aio import reap
 from ...utils.data import blake2sum, gen_uuid
 from ...utils.latency import mark_op, phase_span
 from ...utils.time_util import now_msec
@@ -130,6 +131,24 @@ async def check_quotas(
 
 
 
+def _absorb_hashes_sync(block: bytes, md5, sha, extra_hash) -> None:
+    """Chain the request-level digests over one block — CPU-bound, so
+    large blocks run it via asyncio.to_thread (the digest objects are
+    only ever advanced from the sequential read loop; hashlib releases
+    the GIL on large buffers)."""
+    md5.update(block)
+    sha.update(block)
+    if extra_hash is not None:
+        extra_hash.update(block)
+
+
+def _prep_block_sync(block: bytes, transform) -> tuple[bytes, bytes]:
+    """(stored_bytes, block_hash) — SSE transform + content hash, the
+    CPU-bound head of put_one (to_thread above the offload threshold)."""
+    stored = transform(block) if transform else block
+    return stored, blake2sum(stored)
+
+
 async def stream_blocks(
     garage, vid: bytes, bucket_id: bytes, key: str, part_number: int,
     body, block_size: int, first: bytes = b"", transform=None, extra_hash=None,
@@ -138,25 +157,53 @@ async def stream_blocks(
     chunk the body, store blocks with bounded parallelism
     (PUT_BLOCKS_MAX_PARALLEL), record version block entries + block refs
     as we go.  Returns (md5_hex, sha_obj, total_bytes); on failure the
-    caller is responsible for tombstoning `vid`."""
+    caller is responsible for tombstoning `vid`.
+
+    Pipelining: block N's CPU work (hash, SSE, codec encode — all off
+    the event loop) overlaps block N-1's fan-out, because up to
+    PUT_BLOCKS_MAX_PARALLEL put_one tasks run concurrently and none of
+    their stages blocks the loop anymore.  The
+    `api_s3_overlap_efficiency{op="put"}` gauge (utils/latency.py) is
+    the direct measure: 1.0 = the old strictly-sequential pipeline,
+    below 1.0 = the stages genuinely overlap."""
     md5 = hashlib.md5()
     sha = hashlib.sha256()
     total = 0
     offset = 0
+    offload_min = garage.config.block.cpu_offload_min_bytes
     inflight: set[asyncio.Task] = set()
 
-    async def put_one(block: bytes, block_offset: int):
-        with phase_span("hash"):
-            stored = transform(block) if transform else block
-            h = blake2sum(stored)
-        await garage.block_manager.rpc_put_block(h, stored)
+    async def put_meta(h: bytes, stored_len: int, block_offset: int):
         with phase_span("meta_commit"):
             v = Version(vid, bucket_id, key)
             v.blocks.put(
-                [part_number, block_offset], {"h": h, "s": len(stored)}
+                [part_number, block_offset], {"h": h, "s": stored_len}
             )
-            await garage.version_table.insert(v)
-            await garage.block_ref_table.insert(BlockRef(h, vid))
+            # independent tables: commit both rows in one round-trip
+            # window instead of two sequential quorum waits
+            await asyncio.gather(
+                garage.version_table.insert(v),
+                garage.block_ref_table.insert(BlockRef(h, vid)),
+            )
+
+    async def put_one(block: bytes, block_offset: int):
+        with phase_span("hash"):
+            if len(block) >= offload_min:
+                stored, h = await asyncio.to_thread(
+                    _prep_block_sync, block, transform
+                )
+            else:
+                stored, h = _prep_block_sync(block, transform)
+        # block fan-out and meta rows commit CONCURRENTLY (reference
+        # put.rs put_block_and_meta's try_join!): the meta quorum wait
+        # used to serialize after the piece quorum wait, ~doubling the
+        # per-block critical path.  Failure of either leg raises out of
+        # stream_blocks and the caller's tombstone (version aborted /
+        # deleted marker) cascades the cleanup of whichever half landed.
+        await asyncio.gather(
+            garage.block_manager.rpc_put_block(h, stored),
+            put_meta(h, len(stored), block_offset),
+        )
 
     async def launch(block: bytes, block_offset: int):
         # backpressure: at most PUT_BLOCKS_MAX_PARALLEL blocks buffered in
@@ -166,20 +213,27 @@ async def stream_blocks(
             done, _ = await asyncio.wait(inflight, return_when=asyncio.FIRST_COMPLETED)
             for t in done:
                 inflight.discard(t)
-                if t.exception():
-                    raise t.exception()
+                # result() re-raises with the task's own traceback —
+                # `raise t.exception()` raised a bare instance whose
+                # context started HERE, losing the put_one frames
+                t.result()
         inflight.add(asyncio.create_task(put_one(block, block_offset)))
+
+    async def absorb(block: bytes) -> None:
+        with phase_span("hash"):
+            if len(block) >= offload_min:
+                await asyncio.to_thread(
+                    _absorb_hashes_sync, block, md5, sha, extra_hash
+                )
+            else:
+                _absorb_hashes_sync(block, md5, sha, extra_hash)
 
     try:
         buf = first
         while True:
             while len(buf) >= block_size:
                 block, buf = buf[:block_size], buf[block_size:]
-                with phase_span("hash"):
-                    md5.update(block)
-                    sha.update(block)
-                    if extra_hash is not None:
-                        extra_hash.update(block)
+                await absorb(block)
                 await launch(block, offset)
                 offset += len(block)
                 total += len(block)
@@ -189,18 +243,17 @@ async def stream_blocks(
                 break
             buf += chunk
         if buf:
-            with phase_span("hash"):
-                md5.update(buf)
-                sha.update(buf)
-                if extra_hash is not None:
-                    extra_hash.update(buf)
+            await absorb(buf)
             await launch(buf, offset)
             total += len(buf)
         if inflight:
             await asyncio.gather(*inflight)
     except BaseException:
-        for t in inflight:
-            t.cancel()
+        # cancel + DRAIN: a bare t.cancel() abandoned the in-flight
+        # tasks mid-write — their exceptions surfaced as never-retrieved
+        # warnings and a cancelled put could still be touching the
+        # version table while the caller tombstoned it
+        await reap(inflight, log=logger, what="put-block task")
         raise
     return md5.hexdigest(), sha, total
 
@@ -262,8 +315,11 @@ async def handle_put_object(
     vid = gen_uuid()
     version0 = ObjectVersion(vid, ts, "uploading", {"t": "first_block", "vid": vid})
     with phase_span("meta_commit"):
-        await garage.object_table.insert(Object(bucket_id, key, [version0]))
-        await garage.version_table.insert(Version(vid, bucket_id, key))
+        # independent tables: one quorum round-trip window, not two
+        await asyncio.gather(
+            garage.object_table.insert(Object(bucket_id, key, [version0])),
+            garage.version_table.insert(Version(vid, bucket_id, key)),
+        )
     buf_first = first
 
     try:

@@ -1,0 +1,248 @@
+"""CodecBatcher: cross-request coalescing of foreground EC encodes.
+
+ROADMAP item 1: the EC PUT path used to call `codec.encode(data)`
+synchronously per block, so N concurrent PUT requests serialized N
+single-block codec dispatches on the event loop — the batched offload
+the BASELINE.json north star is about never reached the foreground
+write path (only the PR 4 repair plane batched).  This module closes
+that gap with a dynamic batcher in front of the codec:
+
+  - concurrent `encode()` calls queue their blocks and share ONE
+    coalesced dispatch (`EcCodec.encode_batch_hashed`: fused
+    encode+BLAKE3 on device backends with power-of-two batch buckets
+    and donated inputs, native C codec + batched native BLAKE3 on the
+    host backend);
+
+  - a lone request flushes after a bounded linger (`linger_msec`,
+    default 2 ms — noise against the EC PUT's quorum round-trips, so
+    single-client latency never regresses), while a full batch
+    (`max_blocks` / `max_bytes`) flushes immediately;
+
+  - the dispatch itself runs in a worker thread (`asyncio.to_thread`),
+    so the codec math never blocks the event loop between any two
+    requests — the pre-batcher pipeline's real serialization point;
+
+  - a dispatch error fails only that batch's waiters; a cancelled PUT
+    abandons its entry without poisoning the other requests coalesced
+    into the same dispatch.
+
+Phase attribution (utils/latency.py): the submitting request records
+`codec_batch_wait` (queue time until its dispatch starts) separately
+from `encode` (the dispatch itself), so the X-ray waterfall shows
+whether latency went to coalescing or to the codec.
+
+Metric families (doc/monitoring.md):
+
+  block_codec_batch_size          blocks per coalesced dispatch (H)
+  block_codec_batch_dispatch_total{flush}  dispatches by flush reason
+                                  (full | linger | drain)
+  block_codec_batch_coalesced_total  blocks that shared a dispatch
+                                  with at least one other block
+  block_codec_batch_queue_depth{id}  blocks waiting in the batcher (G)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+
+from ..utils.aio import spawn_supervised
+from ..utils.error import Error
+from ..utils.latency import phase_span
+from ..utils.metrics import SIZE_BUCKETS, registry
+
+logger = logging.getLogger("garage.block.codec_batch")
+
+registry.set_buckets("block_codec_batch_size", SIZE_BUCKETS)
+
+# gauge `id` source: process-wide (several in-process nodes share the
+# registry; per-node ids would collide — utils/background.py pattern)
+_gauge_ids = itertools.count(1)
+
+
+class _Entry:
+    __slots__ = ("data", "arrived", "started", "fut")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.arrived = time.monotonic()
+        # set when this entry's dispatch begins (ends codec_batch_wait)
+        self.started = asyncio.Event()
+        self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
+
+
+class CodecBatcher:
+    """Short-linger queue coalescing concurrent block encodes into
+    mesh-sized codec dispatches.  One instance per BlockManager (per
+    node); the flusher task spawns lazily on first use and is reaped by
+    `close()`."""
+
+    def __init__(
+        self,
+        codec,
+        *,
+        linger_msec: float = 2.0,
+        max_blocks: int = 64,
+        max_bytes: int = 64 * 1024 * 1024,
+        impl: str = "auto",
+    ):
+        self.codec = codec
+        # live-tunable (BgVars `codec-batch-*`): read on every flush
+        self.linger_msec = float(linger_msec)
+        self.max_blocks = int(max_blocks)
+        self.max_bytes = int(max_bytes)
+        self.impl = impl
+        self._pending: list[_Entry] = []
+        self._pending_bytes = 0
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self._closed = False
+        self._gauge_key = (
+            "block_codec_batch_queue_depth",
+            (("id", str(next(_gauge_ids))),),
+        )
+        registry.register_gauge(
+            *self._gauge_key, lambda: float(len(self._pending))
+        )
+
+    # --- submit side ----------------------------------------------------------
+
+    async def encode(self, data: bytes) -> tuple[list[bytes], list[bytes] | None]:
+        """Queue one block; returns (pieces, piece_hashes | None) once
+        its coalesced dispatch completes.  Runs in the caller's task, so
+        the phase spans land on the caller's trace."""
+        if self._closed:
+            raise Error("codec batcher is closed")
+        entry = _Entry(data)
+        self._pending.append(entry)
+        self._pending_bytes += len(data)
+        self._wake.set()
+        if self._task is None:
+            self._task = spawn_supervised(self._run(), name="codec-batcher")
+        try:
+            with phase_span("codec_batch_wait"):
+                await entry.started.wait()
+            with phase_span("encode"):
+                return await entry.fut
+        except asyncio.CancelledError:
+            # a PUT cancelled mid-batch abandons its slot; the dispatch
+            # (if already in flight) completes for the OTHER waiters,
+            # and `_take`/`_dispatch` skip the cancelled future
+            entry.fut.cancel()
+            raise
+
+    # --- flusher --------------------------------------------------------------
+
+    def _batch_full(self) -> bool:
+        return (
+            len(self._pending) >= self.max_blocks
+            or self._pending_bytes >= self.max_bytes
+        )
+
+    async def _run(self) -> None:
+        while not self._closed:
+            if not self._pending:
+                self._wake.clear()
+                # re-check: an encode() may have queued between the
+                # pending check and the clear
+                if not self._pending:
+                    await self._wake.wait()
+                continue
+            flush = "full"
+            if not self._batch_full():
+                # linger anchored at the HEAD entry's arrival: entries
+                # that queued while a previous dispatch was running have
+                # already waited their window and flush immediately
+                deadline = self._pending[0].arrived + self.linger_msec / 1e3
+                flush = "linger"
+                while True:
+                    self._wake.clear()
+                    if self._batch_full():  # re-check after the clear
+                        flush = "full"
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        await asyncio.wait_for(self._wake.wait(), remaining)
+                    except asyncio.TimeoutError:
+                        break
+            await self._dispatch(self._take(), flush)
+
+    def _take(self) -> list[_Entry]:
+        """Drain up to max_blocks/max_bytes of live entries (cancelled
+        waiters are dropped here, before they cost a dispatch slot)."""
+        batch: list[_Entry] = []
+        size = 0
+        while self._pending and len(batch) < self.max_blocks:
+            if batch and size + len(self._pending[0].data) > self.max_bytes:
+                break
+            e = self._pending.pop(0)
+            self._pending_bytes -= len(e.data)
+            if e.fut.cancelled():
+                e.started.set()
+                continue
+            batch.append(e)
+            size += len(e.data)
+        return batch
+
+    async def _dispatch(self, batch: list[_Entry], flush: str) -> None:
+        if not batch:
+            return
+        for e in batch:
+            e.started.set()
+        registry.observe("block_codec_batch_size", (), float(len(batch)))
+        registry.incr("block_codec_batch_dispatch_total", (("flush", flush),))
+        if len(batch) > 1:
+            registry.incr("block_codec_batch_coalesced_total", by=len(batch))
+        try:
+            # the sync batch encode is handed to a worker thread — the
+            # loop keeps serving other requests' fan-outs while the
+            # codec math runs (graft-lint passed-not-called remedy)
+            results = await asyncio.to_thread(
+                self.codec.encode_batch_hashed,
+                [e.data for e in batch],
+                self.impl,
+            )
+        except Exception as e:  # noqa: BLE001 — fails THIS batch's waiters
+            for ent in batch:
+                if not ent.fut.done():
+                    ent.fut.set_exception(
+                        Error(f"batched codec dispatch failed: {e!r}")
+                    )
+            return
+        except BaseException:
+            # flusher cancelled mid-dispatch (close() during node stop):
+            # this batch was already drained out of _pending, so close()
+            # can't fail its futures — do it here or every waiter of the
+            # in-flight batch hangs forever on `await entry.fut`
+            for ent in batch:
+                if not ent.fut.done():
+                    ent.fut.set_exception(
+                        Error("codec batcher closed mid-dispatch")
+                    )
+            raise
+        for ent, res in zip(batch, results):
+            if not ent.fut.done():  # a waiter may have been cancelled
+                ent.fut.set_result(res)
+
+    async def close(self) -> None:
+        """Fail pending waiters, reap the flusher, drop the gauge (the
+        PR 8 resource rule: registered at creation, unregistered at
+        close)."""
+        self._closed = True
+        self._wake.set()
+        for e in self._pending:
+            e.started.set()
+            if not e.fut.done():
+                e.fut.set_exception(Error("codec batcher is closed"))
+        self._pending.clear()
+        self._pending_bytes = 0
+        if self._task is not None:
+            from ..utils.aio import reap
+
+            await reap([self._task], log=logger, what="codec-batcher flusher")
+            self._task = None
+        registry.unregister_gauge(*self._gauge_key)

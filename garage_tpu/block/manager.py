@@ -117,10 +117,17 @@ def piece_hash(piece: bytes) -> bytes:
     return _py_blake3(piece)
 
 
-def wrap_piece(block_len: int, piece: bytes) -> bytes:
-    return (
-        PIECE_MAGIC + block_len.to_bytes(8, "big") + piece_hash(piece) + piece
-    )
+def wrap_piece(block_len: int, piece: bytes, phash: bytes | None = None) -> bytes:
+    """Build the stored piece header.  `phash` is the sender-provided
+    BLAKE3 of the piece (computed inside the batched encode dispatch,
+    `block/codec_batch.py`): when present the receiving node skips its
+    own per-piece hash.  Trust is unchanged — the sender is already the
+    authority for the piece bytes themselves, and a wrong hash surfaces
+    at scrub exactly like a corrupted piece would (quarantine + resync
+    rebuild)."""
+    if phash is None or len(phash) != 32:
+        phash = piece_hash(piece)
+    return PIECE_MAGIC + block_len.to_bytes(8, "big") + phash + piece
 
 
 def unwrap_piece(stored: bytes, verify: bool = True) -> tuple[int, bytes]:
@@ -209,7 +216,10 @@ class BlockManager:
         data_fsync: bool = False,
         ram_buffer_max: int = 256 * 1024 * 1024,
         disable_scrub: bool = False,
+        block_config=None,
     ):
+        from ..utils.config import BlockConfig
+
         self.system = system
         self.helper = helper
         self.db = db
@@ -220,6 +230,25 @@ class BlockManager:
         self.disable_scrub = disable_scrub
         self.buffers = ByteBudget(ram_buffer_max)
         self.rc = BlockRc(db)
+        # foreground codec batcher ([block] knobs, utils/config.py):
+        # coalesces concurrent PUT encodes into one dispatch.  EC only —
+        # the replica codec has no encode step to batch.
+        self.block_config = block_config or BlockConfig()
+        self.batcher = None
+        if (
+            self.codec.n_pieces > 1
+            and self.block_config.batch_enabled
+            and hasattr(self.codec, "encode_batch_hashed")
+        ):
+            from .codec_batch import CodecBatcher
+
+            self.batcher = CodecBatcher(
+                self.codec,
+                linger_msec=self.block_config.batch_linger_msec,
+                max_blocks=self.block_config.batch_max_blocks,
+                max_bytes=self.block_config.batch_max_bytes,
+                impl=self.block_config.batch_impl,
+            )
         # seedable disk-fault seam (net/fault.py FaultPlan): when set,
         # local block reads/writes may fail per the plan's probabilities
         self.fault_plan = None
@@ -428,10 +457,20 @@ class BlockManager:
                 piece = int(meta.get("p", 0))
                 if self.codec.n_pieces == 1 and not bool(meta.get("c")):
                     # replica mode stores the block itself: verify first
-                    if blake2sum(payload) != hash32:
+                    # (hashing a whole block is CPU-bound — off the loop
+                    # above the same threshold the sender uses)
+                    if len(payload) >= self.block_config.cpu_offload_min_bytes:
+                        digest = await asyncio.to_thread(blake2sum, payload)
+                    else:
+                        digest = blake2sum(payload)
+                    if digest != hash32:
                         raise Error("put payload does not match block hash")
                 if "l" in meta:  # fresh EC piece: wrap with its block length
-                    payload = wrap_piece(int(meta["l"]), payload)
+                    ph = meta.get("ph")
+                    payload = wrap_piece(
+                        int(meta["l"]), payload,
+                        phash=bytes(ph) if ph is not None else None,
+                    )
                 await self.write_block_local(
                     hash32, payload, bool(meta.get("c")), piece=piece
                 )
@@ -482,6 +521,29 @@ class BlockManager:
             return Resp(len(hashes))
         raise Error(f"unknown block op {op[0]!r}")
 
+    async def close(self) -> None:
+        """Tear down foreground resources (Garage.stop): the codec
+        batcher's flusher task and its queue-depth gauge."""
+        if self.batcher is not None:
+            await self.batcher.close()
+
+    async def _encode_ec(
+        self, data: bytes
+    ) -> tuple[list[bytes], list[bytes] | None]:
+        """EC piece encode for the foreground write path: coalesced with
+        concurrent requests through the batcher when enabled (which also
+        yields the per-piece BLAKE3 hashes from the fused dispatch);
+        otherwise a single-block dispatch in a worker thread.  Either
+        way the codec math stays OFF the event loop — the pre-batcher
+        pipeline's real serialization point under concurrency."""
+        if self.batcher is not None:
+            return await self.batcher.encode(data)
+        from ..utils.latency import phase_span
+
+        with phase_span("encode"):
+            pieces = await asyncio.to_thread(self.codec.encode, data)
+        return pieces, None
+
     # --- cluster ops ----------------------------------------------------------
 
     async def rpc_put_block(self, hash32: bytes, data: bytes) -> None:
@@ -504,7 +566,20 @@ class BlockManager:
         quorum = self.system.replication_mode.write_quorum()
         if self.codec.n_pieces == 1:
             with phase_span("encode"):
-                stored, compressed = self._maybe_compress(data)
+                # zstd is CPU-bound: at block sizes a thread hop is noise
+                # against the compression itself, so large blocks leave
+                # the event loop (graft-lint can't see this blocker —
+                # it's compute, not I/O — but it stalled every concurrent
+                # request for the duration of a block compression)
+                if (
+                    self.compression_level is not None
+                    and len(data) >= self.block_config.cpu_offload_min_bytes
+                ):
+                    stored, compressed = await asyncio.to_thread(
+                        self._maybe_compress, data
+                    )
+                else:
+                    stored, compressed = self._maybe_compress(data)
             async with self.buffers.reserve(len(stored)):
                 # replica sends + their quorum wait are one awaited call;
                 # the whole window is attributed to the fan-out phase.
@@ -536,8 +611,7 @@ class BlockManager:
         # heal via resync anyway).  Waiting for ALL k+m sends made the EC
         # PUT p99 the max over k+m nodes vs the replica path's
         # quorum-of-RF, measurably fattening the tail (bench_s3.py).
-        with phase_span("encode"):
-            pieces = self.codec.encode(data)
+        pieces, piece_hashes = await self._encode_ec(data)
         send_targets, per_version = self._ec_piece_targets(hash32, layout)
         # quorum counts DISTINCT pieces stored per layout version; tolerate
         # up to half the parity pieces missing (resync rebuilds them) — but
@@ -574,11 +648,16 @@ class BlockManager:
                     # S3-path work — PRIO_NORMAL, same class as the
                     # replica fan-out above (interactive reads outrank
                     # it at PRIO_HIGH; background planes sit below)
+                    meta = {"c": False, "p": i, "l": len(data),
+                            "s": len(pieces[i])}
+                    if piece_hashes is not None:
+                        # hash computed inside the batched encode
+                        # dispatch: the receiver stores it instead of
+                        # re-hashing the piece on its event loop
+                        meta["ph"] = piece_hashes[i]
                     await self.helper.call(
                         self.endpoint, n,
-                        ["Put", hash32,
-                         {"c": False, "p": i, "l": len(data),
-                          "s": len(pieces[i])}],
+                        ["Put", hash32, meta],
                         prio=PRIO_NORMAL,
                         # same deadline as the caller's quorum wait below
                         # — a longer per-send default would abort slow-

@@ -193,6 +193,7 @@ class Garage:
             data_fsync=config.data_fsync,
             ram_buffer_max=config.block_ram_buffer_max,
             disable_scrub=config.disable_scrub,
+            block_config=config.block,
         )
 
         # tables, wired with their reactive cross-links
@@ -280,6 +281,25 @@ class Garage:
             "resync-worker-count",
             lambda: str(resync.n_workers),
             lambda v: setattr(resync, "n_workers", max(1, min(8, int(v)))),
+        )
+
+        # codec batcher ([block] knobs): live-tuned on the running
+        # batcher — the flusher reads them on every flush cycle
+        def _batcher():
+            b = self.block_manager.batcher
+            if b is None:
+                raise ValueError("codec batcher not active (replica codec?)")
+            return b
+
+        self.bg_vars.register_rw(
+            "codec-batch-linger-msec",
+            lambda: str(_batcher().linger_msec),
+            lambda v: setattr(_batcher(), "linger_msec", max(0.0, float(v))),
+        )
+        self.bg_vars.register_rw(
+            "codec-batch-max-blocks",
+            lambda: str(_batcher().max_blocks),
+            lambda v: setattr(_batcher(), "max_blocks", max(1, int(v))),
         )
 
         def _scrub_worker():
@@ -409,7 +429,10 @@ class Garage:
                 threshold_ms=adm.slow_request_threshold_msec,
                 top_k=adm.slow_request_top_k,
             )
-            tracer.add_hook(self.flight_recorder.on_span_end)
+            # shared fanout, NOT a per-node tracer hook: several
+            # in-process nodes would otherwise buffer + serialize every
+            # span once per node (utils/flight.py _SharedSpanFanout)
+            flight.attach_recorder(self.flight_recorder)
         if adm.event_loop_watchdog_threshold_msec:
             self.watchdog = flight.EventLoopWatchdog(
                 threshold=adm.event_loop_watchdog_threshold_msec / 1000.0
@@ -615,7 +638,9 @@ class Garage:
             self.watchdog.stop()
             self.watchdog = None
         if self.flight_recorder is not None:
-            tracer.remove_hook(self.flight_recorder.on_span_end)
+            from ..utils import flight
+
+            flight.detach_recorder(self.flight_recorder)
             self.flight_recorder = None
         if self._latency_enabled:
             from ..utils import latency
@@ -623,6 +648,7 @@ class Garage:
             latency.disable()
             self._latency_enabled = False
         await self.bg.shutdown()
+        await self.block_manager.close()
         if self.canary is not None:
             # after bg.shutdown(): the worker is cancelled, nothing is
             # mid-probe on this session anymore

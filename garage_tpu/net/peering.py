@@ -102,7 +102,7 @@ class PeeringManager:
         return Resp(req.body)  # echo nonce
 
     async def _handle_peer_list(self, from_id: bytes, req: Req) -> Resp:
-        self._learn(req.body or [])
+        self._learn(req.body or [], from_id=from_id)
         return Resp(self._known_list())
 
     def _known_list(self) -> list:
@@ -113,15 +113,39 @@ class PeeringManager:
                 out.append([p.id, list(p.addr)])
         return out
 
-    def _learn(self, peer_list) -> None:
+    def _learn(self, peer_list, from_id: bytes | None = None) -> None:
+        """Merge a peer-list exchange.  `from_id` is the reporting peer:
+        its OWN entry is authoritative for its address — a peer that
+        crashed and restarted on a new port (redeploy; the jepsen
+        crash/restart nemesis) used to be unreachable forever once the
+        connections it had dialed died, because the stale address was
+        never overwritten and every redial backed off against a dead
+        port.  Third-party entries only fill unknown addresses (gossip
+        re-propagating a stale address must not clobber a fresh
+        authoritative one)."""
         for item in peer_list:
             pid, addr = bytes(item[0]), (item[1][0], int(item[1][1]))
             if pid == self.netapp.id:
                 continue
-            if pid not in self.peers:
+            p = self.peers.get(pid)
+            if p is None:
                 self.peers[pid] = PeerInfo(id=pid, addr=addr)
-            elif self.peers[pid].addr is None:
-                self.peers[pid].addr = addr
+            elif p.addr is None:
+                p.addr = addr
+            elif (
+                pid == from_id
+                and p.addr != addr
+                # a node without rpc_public_addr self-reports its BIND
+                # address, which may be a wildcard — never overwrite a
+                # dialable address with an undialable one
+                and addr[0] not in ("", "0.0.0.0", "::")
+                and addr[1] != 0
+            ):
+                p.addr = addr
+                # the old address's connect backoff is meaningless for
+                # the new one: redial promptly
+                p.connect_failures = 0
+                p.next_retry = 0.0
 
     def _on_connected(self, pid: bytes, incoming: bool) -> None:
         info = self.peers.setdefault(pid, PeerInfo(id=pid))
@@ -192,7 +216,7 @@ class PeeringManager:
                 p.id, self._known_list(), prio=PRIO_HIGH,
                 timeout=self.ping_timeout,
             )
-            self._learn(resp.body or [])
+            self._learn(resp.body or [], from_id=p.id)
         except Exception:  # noqa: BLE001
             p.failed_pings += 1
             if self.health is not None:

@@ -38,6 +38,8 @@ against the numpy LUT reference in tests/test_ec.py.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from ..utils.compile_cache import instrumented_cache, record_cache_event
@@ -186,6 +188,42 @@ def _ec_body(plat: str, impl: str | None):
     return body
 
 
+def bucket_batch(b: int) -> int:
+    """Round a block-batch size up to its power-of-two shape class.
+
+    The foreground codec batcher coalesces RAGGED batches (whatever
+    arrived during the linger window), and XLA compiles one executable
+    per input shape: unbucketed batch sizes would compile a fresh kernel
+    for every distinct concurrency level the node ever sees.  Padding
+    the batch axis to a power of two bounds the compile cache at
+    log2(max_batch) entries per shard shape; pad blocks are zeros and
+    their outputs are sliced off host-side (GF coding of a zero block is
+    zeros — nothing leaks between tenants)."""
+    if b <= 1:
+        return 1
+    return 1 << (b - 1).bit_length()
+
+
+def _pad_batch(x: np.ndarray, b_padded: int) -> np.ndarray:
+    if x.shape[0] == b_padded:
+        return x
+    return np.concatenate(
+        [x, np.zeros((b_padded - x.shape[0], *x.shape[1:]), np.uint8)]
+    )
+
+
+def _donate_kwargs(plat: str) -> dict:
+    """donate_argnums for the consume-once shard input: the fused
+    foreground encode reads the data shards exactly once per dispatch,
+    so on device backends the input buffer is donated to the output,
+    removing a full HBM copy per dispatch (SNIPPETS pjit exemplar
+    pattern).  CPU XLA cannot honor donation and warns per compile —
+    skip it there.  Only the fused encode+hash path donates: the generic
+    `ec_apply_fn` is also driven with long-lived device arrays
+    (bench.py's timing loop) that a donation would invalidate."""
+    return {} if plat in ("cpu",) else {"donate_argnums": (1,)}
+
+
 @instrumented_cache("ec_apply")
 def ec_apply_fn(platform: str | None = None, impl: str | None = None):
     """Jitted `fn(bitmat_uint8, x_uint8) -> out_uint8`, cached per
@@ -233,6 +271,47 @@ def ec_apply_fn_mesh(
         body, mesh=mesh, in_specs=(P(), P(axis)), out_specs=P(axis)
     )
     return jax.jit(fn), mesh
+
+
+def blake3_supported_len(s: int) -> bool:
+    """Shard lengths the batched BLAKE3 kernel accepts (ops/hash_tpu.py):
+    any multiple of 64 up to one chunk, or a power-of-two number of full
+    1024-byte chunks.  Shard-size classes outside this set fall back to
+    host-side piece hashing."""
+    if s <= 0 or s % 64:
+        return False
+    if s <= 1024:
+        return True
+    return s % 1024 == 0 and (s // 1024).bit_count() == 1
+
+
+@instrumented_cache("ec_encode_hash")
+def ec_encode_hash_fn(platform: str | None, impl: str | None, s: int):
+    """Jitted fused foreground-encode dispatch: `fn(bitmat, x (B,k,S))
+    -> (parity (B,m,S), hashes (B,k+m,32))` — the EC coding matmul AND
+    the BLAKE3 of every data+parity shard in ONE device dispatch, so
+    the per-piece integrity hashes (block/manager.py wrap_piece) ride
+    the encode instead of costing k+m host hashes per block.  The shard
+    input is donated on device backends (consume-once)."""
+    jax = _jax()
+    import jax.numpy as jnp
+
+    from .hash_tpu import blake3_batch_fn
+
+    plat = platform or jax.default_backend()
+    ec_body = _ec_body(plat, impl)
+    hash_fn = blake3_batch_fn(s)
+
+    def body(bitmat, x):
+        b, k, _s = x.shape
+        parity = ec_body(bitmat, x)
+        shards = jnp.concatenate([x, parity], axis=1)  # (B, k+m, S)
+        n = shards.shape[1]
+        hashes = hash_fn(shards.reshape(b * n, s)).reshape(b, n, 32)
+        return parity, hashes
+
+    kwargs = {"backend": platform} if platform else {}
+    return jax.jit(body, **kwargs, **_donate_kwargs(plat))
 
 
 # legacy alias used by the fused pipeline (portable einsum body)
@@ -356,6 +435,42 @@ class EcTpu:
         """(B, k, S) data shards -> (B, m, S) parity shards."""
         assert data.ndim == 3 and data.shape[1] == self.k and data.dtype == np.uint8
         return self._apply(self._enc_bitmat, data, "ec_encode")
+
+    def encode_and_hash(
+        self, data: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray | None]:
+        """Foreground fused dispatch: (B, k, S) data shards ->
+        (parity (B, m, S), BLAKE3 hashes (B, k+m, 32) or None).
+
+        The batch axis is padded to its power-of-two bucket
+        (`bucket_batch`) so ONE compiled executable serves every ragged
+        batch the codec batcher coalesces; pad rows are sliced off.
+        Hashes are None when the shard length is outside the batched
+        BLAKE3 kernel's supported set, or when the fused lowering is
+        unavailable — callers then hash host-side (or let the receiving
+        node hash, the pre-batcher behavior)."""
+        assert data.ndim == 3 and data.shape[1] == self.k and data.dtype == np.uint8
+        b, _k, s = data.shape
+        if not blake3_supported_len(s):
+            return self.encode(data), None
+        bucket = bucket_batch(b)
+        record_cache_event("ec_batch_bucket", bucket == b)
+        x = _pad_batch(np.asarray(data), bucket)
+        plat = telemetry.resolved_platform(self.platform)
+        for impl in dict.fromkeys((self._impl, "einsum")):
+            try:
+                fn = ec_encode_hash_fn(self.platform, impl, s)
+                with telemetry.dispatch("ec_encode_hash", plat, b, data.nbytes):
+                    parity, hashes = fn(self._enc_bitmat, x)
+                    parity, hashes = np.asarray(parity), np.asarray(hashes)
+                self._impl = impl
+                return parity[:b], hashes[:b]
+            except Exception as e:  # noqa: BLE001 — fused path optional
+                logging.getLogger("garage.ops.ec").warning(
+                    "fused encode+hash (impl=%s) failed (%r); "
+                    "falling back", impl, e,
+                )
+        return self.encode(data), None
 
     def reconstruct(
         self, shards: np.ndarray, present: list[int], want: list[int]

@@ -170,6 +170,30 @@ class OverloadConfig:
 
 
 @dataclass
+class BlockConfig:
+    """Rebuild-specific: foreground block-layer tuning — the cross-
+    request codec batcher (block/codec_batch.py) and the CPU-offload
+    thresholds of the PUT pipeline.  `codec-batch-linger-msec` /
+    `codec-batch-max-blocks` tune the live batcher via `worker set`."""
+
+    # cross-request codec batcher (EC write path)
+    batch_enabled: bool = True
+    # how long a lone block may wait for companions before its dispatch
+    # flushes anyway — bounds the single-client latency tax
+    batch_linger_msec: float = 2.0
+    # a full batch flushes immediately (mesh-sized dispatch ceiling)
+    batch_max_blocks: int = 64
+    batch_max_bytes: int = 64 * 1024 * 1024
+    # dispatch backend: "auto" (device kernel on TPU backends, native
+    # host codec on CPU), or force "xla" / "host"
+    batch_impl: str = "auto"
+    # CPU-bound work this size or larger leaves the event loop
+    # (replica-path zstd, content hashing): below it the thread-hop
+    # overhead exceeds the stall it avoids
+    cpu_offload_min_bytes: int = 64 * 1024
+
+
+@dataclass
 class TpuConfig:
     """Rebuild-specific: the TPU compute plane used by the EC block codec and
     batched scrub hashing (no analog in the reference)."""
@@ -236,6 +260,7 @@ class Config:
     k2v_api: K2VApiConfig = field(default_factory=K2VApiConfig)
     s3_web: WebConfig = field(default_factory=WebConfig)
     admin: AdminConfig = field(default_factory=AdminConfig)
+    block: BlockConfig = field(default_factory=BlockConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
     repair: RepairPlanConfig = field(default_factory=RepairPlanConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
@@ -448,6 +473,8 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             cfg.s3_web = WebConfig(**_known(v, WebConfig))
         elif k == "admin":
             cfg.admin = AdminConfig(**_known(v, AdminConfig))
+        elif k == "block":
+            cfg.block = BlockConfig(**_known(v, BlockConfig))
         elif k == "tpu":
             cfg.tpu = TpuConfig(**_known(v, TpuConfig))
         elif k == "repair":
@@ -518,6 +545,23 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         )
     if float(ov.loop_lag_p99_msec) <= 0:
         raise ValueError("overload.loop_lag_p99_msec must be > 0")
+    # block-layer batching knobs: refuse values that would wedge the
+    # batcher at load time (a zero-block batch cap can never dispatch;
+    # a negative linger is a time-travel request)
+    blk = cfg.block
+    if float(blk.batch_linger_msec) < 0:
+        raise ValueError("block.batch_linger_msec must be >= 0")
+    if int(blk.batch_max_blocks) < 1:
+        raise ValueError("block.batch_max_blocks must be >= 1")
+    if int(blk.batch_max_bytes) < 1:
+        raise ValueError("block.batch_max_bytes must be >= 1")
+    if blk.batch_impl not in ("auto", "host", "xla"):
+        raise ValueError(
+            f"invalid block.batch_impl {blk.batch_impl!r}: "
+            'want "auto", "host" or "xla"'
+        )
+    if int(blk.cpu_offload_min_bytes) < 0:
+        raise ValueError("block.cpu_offload_min_bytes must be >= 0")
     # resolve secrets
     cfg.rpc_secret = _get_secret(
         cfg.rpc_secret,

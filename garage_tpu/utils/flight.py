@@ -431,20 +431,7 @@ class SlowRequestRecorder:
         ent = self.pending.get(root.trace_id)
         if ent is None:
             return
-        spans = ent[1]
-        # extract the subtree under `root` (other local roots of the same
-        # trace, if any, keep buffering until they end or expire)
-        children: dict[bytes, list] = {}
-        for s in spans:
-            if s.parent_id is not None:
-                children.setdefault(s.parent_id, []).append(s)
-        tree, frontier = [root], [root.span_id]
-        while frontier:
-            kids = children.pop(frontier.pop(), [])
-            tree.extend(kids)
-            frontier.extend(k.span_id for k in kids)
-        tree_ids = {id(s) for s in tree}
-        rest = [s for s in spans if id(s) not in tree_ids]
+        tree, rest = _extract_tree(root, ent[1])
         if rest:
             ent[1] = rest
         else:
@@ -455,43 +442,7 @@ class SlowRequestRecorder:
         duration_ms = (root.end_ns - root.start_ns) / 1e6
         if duration_ms < self.threshold_ms:
             return
-        t0 = root.start_ns
-        # phase waterfall (utils/latency.py): "why was THIS request
-        # slow" answered per-phase, not just as a raw span tree
-        try:
-            from .latency import critical_path
-
-            waterfall = critical_path(root, tree)
-            if not waterfall["phases"]:
-                waterfall = None
-        # graft-lint: allow-swallow(waterfall is an optional enrichment of the slow record)
-        except Exception:  # noqa: BLE001 — diagnostics must never raise
-            waterfall = None
-        self.records.append(
-            {
-                "traceId": root.trace_id.hex(),
-                "name": root.name,
-                "start": root.start_ns / 1e9,
-                "durationMs": round(duration_ms, 3),
-                "ok": root.ok,
-                "phases": waterfall,
-                "attrs": {k: str(v) for k, v in root.attrs.items()},
-                "spans": [
-                    {
-                        "name": s.name,
-                        "spanId": s.span_id.hex(),
-                        "parentSpanId": s.parent_id.hex()
-                        if s.parent_id
-                        else None,
-                        "startMs": round((s.start_ns - t0) / 1e6, 3),
-                        "durationMs": round((s.end_ns - s.start_ns) / 1e6, 3),
-                        "ok": s.ok,
-                        "attrs": {k: str(v) for k, v in s.attrs.items()},
-                    }
-                    for s in sorted(tree, key=lambda s: s.start_ns)
-                ],
-            }
-        )
+        self.records.append(_build_record(root, tree, duration_ms))
 
     def _sweep(self) -> None:
         """Expire parentless trees (remote `rpc-handle:*` subtrees, or
@@ -520,6 +471,174 @@ class SlowRequestRecorder:
     def snapshot(self) -> list[dict]:
         """Retained slow requests, slowest first."""
         return sorted(self.records, key=lambda r: -r["durationMs"])
+
+
+def _extract_tree(root, spans) -> tuple[list, list]:
+    """Split `spans` into (subtree under `root`, the rest).  Other local
+    roots of the same trace keep buffering until they end or expire."""
+    children: dict[bytes, list] = {}
+    for s in spans:
+        if s.parent_id is not None:
+            children.setdefault(s.parent_id, []).append(s)
+    tree, frontier = [root], [root.span_id]
+    while frontier:
+        kids = children.pop(frontier.pop(), [])
+        tree.extend(kids)
+        frontier.extend(k.span_id for k in kids)
+    tree_ids = {id(s) for s in tree}
+    return tree, [s for s in spans if id(s) not in tree_ids]
+
+
+def _build_record(root, tree, duration_ms: float) -> dict:
+    t0 = root.start_ns
+    # phase waterfall (utils/latency.py): "why was THIS request
+    # slow" answered per-phase, not just as a raw span tree
+    try:
+        from .latency import critical_path
+
+        waterfall = critical_path(root, tree)
+        if not waterfall["phases"]:
+            waterfall = None
+    # graft-lint: allow-swallow(waterfall is an optional enrichment of the slow record)
+    except Exception:  # noqa: BLE001 — diagnostics must never raise
+        waterfall = None
+    return {
+        "traceId": root.trace_id.hex(),
+        "name": root.name,
+        "start": root.start_ns / 1e9,
+        "durationMs": round(duration_ms, 3),
+        "ok": root.ok,
+        "phases": waterfall,
+        "attrs": {k: str(v) for k, v in root.attrs.items()},
+        "spans": [
+            {
+                "name": s.name,
+                "spanId": s.span_id.hex(),
+                "parentSpanId": s.parent_id.hex()
+                if s.parent_id
+                else None,
+                "startMs": round((s.start_ns - t0) / 1e6, 3),
+                "durationMs": round((s.end_ns - s.start_ns) / 1e6, 3),
+                "ok": s.ok,
+                "attrs": {k: str(v) for k, v in s.attrs.items()},
+            }
+            for s in sorted(tree, key=lambda s: s.start_ns)
+        ],
+    }
+
+
+class _SharedSpanFanout:
+    """Process-wide span buffering shared by every ATTACHED recorder.
+
+    Several in-process Garage nodes each run a flight recorder, but the
+    tracer is process-global: registering every recorder as its own
+    tracer hook made EVERY span buffer + finalize once per node — the
+    single biggest event-loop cost under a concurrent S3 workload on an
+    11-node in-process cluster (the span fan-out work scaled as
+    nodes x spans, ~28% of total loop time in the EC PUT bench).  This
+    is the SlowRequestRecorder analog of the PhaseAggregator singleton
+    rule (utils/latency.py): buffer each span ONCE, extract each
+    finished subtree ONCE, serialize a slow record ONCE, and hand the
+    shared result to every attached recorder's ring.
+
+    Recorders used directly as tracer hooks (tests, ad-hoc tooling)
+    keep their standalone `on_span_end` path; `attach()`/`detach()` is
+    how Garage wires them."""
+
+    SWEEP_EVERY = SlowRequestRecorder.SWEEP_EVERY
+    MAX_PENDING_TRACES = SlowRequestRecorder.MAX_PENDING_TRACES
+    MAX_SPANS_PER_TRACE = SlowRequestRecorder.MAX_SPANS_PER_TRACE
+    PENDING_TTL = SlowRequestRecorder.PENDING_TTL
+
+    def __init__(self):
+        self.recorders: list[SlowRequestRecorder] = []
+        self.pending: dict[bytes, list] = {}
+        self._calls = 0
+
+    def attach(self, rec: SlowRequestRecorder) -> None:
+        from .tracing import tracer
+
+        if rec not in self.recorders:
+            self.recorders.append(rec)
+        if len(self.recorders) == 1:
+            tracer.add_hook(self.on_span_end)
+
+    def detach(self, rec: SlowRequestRecorder) -> None:
+        from .tracing import tracer
+
+        if rec in self.recorders:
+            self.recorders.remove(rec)
+        if not self.recorders:
+            tracer.remove_hook(self.on_span_end)
+            self.pending.clear()
+
+    def on_span_end(self, span) -> None:
+        self._calls += 1
+        if self._calls % self.SWEEP_EVERY == 0:
+            self._sweep()
+        ent = self.pending.get(span.trace_id)
+        if ent is None:
+            if len(self.pending) >= self.MAX_PENDING_TRACES:
+                self._expire(next(iter(self.pending)))
+            ent = self.pending[span.trace_id] = [time.monotonic(), []]
+        ent[0] = time.monotonic()
+        if len(ent[1]) < self.MAX_SPANS_PER_TRACE:
+            ent[1].append(span)
+        else:
+            for rec in self.recorders:
+                rec.dropped += 1
+        if span.parent_id is None:
+            ent = self.pending.get(span.trace_id)
+            if ent is None:
+                return
+            tree, rest = _extract_tree(span, ent[1])
+            if rest:
+                ent[1] = rest
+            else:
+                del self.pending[span.trace_id]
+            self._record(span, tree)
+
+    def _record(self, root, tree) -> None:
+        duration_ms = (root.end_ns - root.start_ns) / 1e6
+        record = None  # serialized at most once, shared by every ring
+        for rec in self.recorders:
+            if duration_ms < rec.threshold_ms:
+                continue
+            if record is None:
+                record = _build_record(root, tree, duration_ms)
+            rec.records.append(record)
+
+    def _sweep(self) -> None:
+        now = time.monotonic()
+        for tid in [
+            t for t, ent in self.pending.items()
+            if now - ent[0] > self.PENDING_TTL
+        ]:
+            self._expire(tid)
+
+    def _expire(self, tid: bytes) -> None:
+        ent = self.pending.pop(tid, None)
+        if ent is None:
+            return
+        spans = ent[1]
+        local_ids = {s.span_id for s in spans}
+        tops = [s for s in spans if s.parent_id not in local_ids]
+        if tops:
+            root = max(tops, key=lambda s: s.end_ns - s.start_ns)
+            self._record(root, spans)
+
+
+# the process-wide fanout (mirrors utils/latency.py `aggregator`)
+span_fanout = _SharedSpanFanout()
+
+
+def attach_recorder(rec: SlowRequestRecorder) -> None:
+    """Register a recorder on the shared fanout (Garage.start)."""
+    span_fanout.attach(rec)
+
+
+def detach_recorder(rec: SlowRequestRecorder) -> None:
+    span_fanout.detach(rec)
 
 
 def slow_response(recorder: "SlowRequestRecorder | None") -> dict:

@@ -65,6 +65,7 @@ logger = logging.getLogger("garage.latency")
 PHASES = (
     "auth",         # SigV4 verification + access-key fetch
     "chunk",        # reading/chunking the request body
+    "codec_batch_wait",  # queue time in the codec batcher before dispatch
     "encode",       # EC piece encoding (or replica compression)
     "hash",         # content hashing (md5/sha/blake2) + SSE transform
     "fanout",       # piece/replica sends to the write set

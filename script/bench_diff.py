@@ -37,10 +37,29 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # artifact file -> [(dotted value path, op, bound, what it guards)]
 FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
     "BENCH_s3_geometry.json": [
-        # measured 3.16x on CPU loopback (PR 2); ROADMAP item 1 targets
-        # <= 1.5x — the ceiling trips if the gap WIDENS past 4x
-        ("value", "<=", 4.0, "EC(8,3)/3-replica S3 PUT p99 ratio"),
-        ("vs_baseline", ">=", 0.25, "PUT p99 ratio vs the 1.2x target"),
+        # PR 2 measured 3.16x; the codec-batcher + pipelined-PUT PR
+        # re-banked at 2.00x on a ~2x slower box — ratchet the ceiling
+        # from 4.0 to 3.0 (single-client runs swing ~±40% with box
+        # noise; 3.0 still trips if the sequential pipeline comes back)
+        ("value", "<=", 3.0, "EC(8,3)/3-replica S3 PUT p99 ratio"),
+        ("vs_baseline", ">=", 0.35, "PUT p99 ratio vs the 1.2x target"),
+    ],
+    "BENCH_s3_concurrency.json": [
+        # ROADMAP item 1 / ISSUE 9 acceptance: EC PUT p99 <= 1.5x the
+        # 3-replica baseline at the 64-client level (banked 1.06)
+        ("value", "<=", 1.5,
+         "EC/replica put-p99 ratio at 64 concurrent clients"),
+        # batching must not tax the unloaded case: single-client EC PUT
+        # p99 stays under the pre-batcher sequential pipeline's ~0.9 s
+        # measured on the banking box (banked 0.66 s; c=1 runs carry
+        # the most box noise, hence the margin)
+        ("detail.levels.1.ec_ms.put_p99", "<=", 900,
+         "single-client EC PUT p99 not taxed by batching (ms)"),
+        # the pipeline genuinely overlaps: wall / sum-of-phases for the
+        # 64-client EC PUT (1.0 = the old strictly-sequential pipeline;
+        # banked 0.84)
+        ("detail.levels.64.ec_phases.overlap_efficiency", "<=", 0.95,
+         "64-client EC PUT pipeline overlap (1.0 = sequential)"),
     ],
     "BENCH_repair_10k.json": [
         # measured 178.5 blocks/s on CPU loopback (PR 4); floor matches

@@ -610,3 +610,39 @@ def test_ordered_substream_gap_does_not_wedge():
         await b.shutdown()
 
     run(main())
+
+
+def test_peer_list_self_report_updates_stale_address():
+    """A peer that crashed and restarted on a NEW port must become
+    dialable again: its own peer-list entry is authoritative for its
+    address (third-party gossip still must not clobber a known address
+    with a stale one)."""
+
+    async def main():
+        a, b = await make_node(), await make_node()
+        try:
+            pa = PeeringManager(a, [(b.id, ("127.0.0.1", 59999))])
+            p = pa.peers[b.id]
+            p.connect_failures = 6  # deep in backoff against the dead addr
+            p.next_retry = 1e18
+
+            # third-party gossip repeating the stale address: no change
+            third_party = os.urandom(32)
+            pa._learn([[b.id, ["127.0.0.1", 58888]]], from_id=third_party)
+            assert pa.peers[b.id].addr == ("127.0.0.1", 59999)
+
+            # b's own self-report wins and resets the dial backoff
+            pa._learn([[b.id, ["127.0.0.1", 51111]]], from_id=b.id)
+            assert pa.peers[b.id].addr == ("127.0.0.1", 51111)
+            assert pa.peers[b.id].connect_failures == 0
+            assert pa.peers[b.id].next_retry == 0.0
+
+            # unknown peers are still learned from any reporter
+            new_id = os.urandom(32)
+            pa._learn([[new_id, ["127.0.0.1", 52222]]], from_id=third_party)
+            assert pa.peers[new_id].addr == ("127.0.0.1", 52222)
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    run(main())
