@@ -13,9 +13,30 @@ mechanically enforces the invariants the repo keeps re-learning by hand:
                        unregistered by it; config knobs read anywhere
                        must be declared (and so validated) at load time
 
+Distributed-correctness families (ISSUE 10):
+
+  cancel-safety        awaits in finally:, swallowed CancelledError, and
+                       cancel()-without-drain — the teardown traps behind
+                       "breakers pinned open" convergence stalls
+  lock-await           RPC / unbounded waits while holding an asyncio
+                       mutex: cluster-wide convoys and deadlocks
+  trust-boundary       pre-auth / peer-supplied values (claimed key ids,
+                       gossiped digests) must pass _esc/validation before
+                       metric labels, log f-strings, or paths
+  wire-compat          digest keys, RPC frame meta keys and Migratable
+                       markers are snapshot-gated (script/wire_schema.json
+                       vs DIGEST_VERSION); CRDT classes may only mutate
+                       state in __init__/merge*/update*
+
+Resolution: name-based plus receiver types learned from constructor
+assignments (``self.x = Foo()``) and parameter annotations — calls like
+``self.persister.save(...)`` resolve one level deep (no general type
+inference).
+
 Run via ``script/graft_lint.py`` (tier-1 gated by
-``tests/test_graft_lint.py`` against ``script/lint_baseline.json``).
-Rule catalogue and pragma syntax: doc/static-analysis.md.
+``tests/test_graft_lint.py`` against ``script/lint_baseline.json``;
+``--diff REF`` for the fast pre-commit loop).  Rule catalogue and
+pragma syntax: doc/static-analysis.md.
 """
 
 from .core import Project, Violation, analyze  # noqa: F401

@@ -31,6 +31,10 @@ PRAGMA_KINDS = {
     "swallow",  # swallowed-exception
     "unpaired-metric",  # resource-discipline (register/unregister)
     "unvalidated-knob",  # resource-discipline (config knobs)
+    "cancel",  # cancel-safety (await-in-finally / swallowed cancel / no-drain)
+    "lock-await",  # lock-across-await (slow await under a mutex)
+    "taint",  # trust-boundary (pre-auth/peer data reaching a sink)
+    "wire",  # wire-compat (CRDT mutation discipline)
 }
 
 
@@ -123,6 +127,70 @@ class SourceFile:
         return None
 
 
+def _ann_class_repr(ann) -> str | None:
+    """Class name out of a parameter annotation: `Foo`, `"Foo"`,
+    `mod.Foo`, `Foo | None`, `Optional[Foo]`."""
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        try:  # string annotation: parse the expression and recurse
+            return _ann_class_repr(ast.parse(ann.value, mode="eval").body)
+        except SyntaxError:
+            return None
+    if isinstance(ann, (ast.Name, ast.Attribute)):
+        parts: list[str] = []
+        n = ann
+        while isinstance(n, ast.Attribute):
+            parts.append(n.attr)
+            n = n.value
+        if isinstance(n, ast.Name):
+            parts.append(n.id)
+            return ".".join(reversed(parts))
+        return None
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        for side in (ann.left, ann.right):
+            if isinstance(side, ast.Constant) and side.value is None:
+                continue
+            r = _ann_class_repr(side)
+            if r is not None:
+                return r
+        return None
+    if isinstance(ann, ast.Subscript):  # Optional[Foo]
+        base = ann.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            return _ann_class_repr(ann.slice)
+    return None
+
+
+def _param_annotations(meth) -> dict[str, str]:
+    out: dict[str, str] = {}
+    a = meth.args
+    for arg in a.posonlyargs + a.args + a.kwonlyargs:
+        if arg.annotation is not None:
+            r = _ann_class_repr(arg.annotation)
+            if r is not None:
+                out[arg.arg] = r
+    return out
+
+
+def _ctor_repr_of(value, ann: dict[str, str]) -> str | None:
+    """The constructor repr a value plausibly came from: a direct call
+    `Foo(...)`, the call branch of `Foo(...) if cond else None`, or a
+    parameter pass-through `self.x = param` where the param carries a
+    class annotation (the one type hint the analyzer honors)."""
+    if isinstance(value, ast.Call):
+        return call_repr(value.func)
+    if isinstance(value, ast.IfExp):
+        ctors = set()
+        for side in (value.body, value.orelse):
+            if isinstance(side, ast.Constant) and side.value is None:
+                continue
+            ctors.add(_ctor_repr_of(side, ann))
+        ctors.discard(None)
+        return ctors.pop() if len(ctors) == 1 else None
+    if isinstance(value, ast.Name):
+        return ann.get(value.id)
+    return None
+
+
 def call_repr(func: ast.AST) -> str | None:
     """Render a Call.func node to a resolvable string: 'name',
     'self.method', or a dotted chain 'a.b.c'.  None for anything
@@ -196,6 +264,12 @@ class Project:
         self._by_name: dict[str, dict[str, list[FunctionInfo]]] = {}
         # per-module: imported name -> (module relpath, original name)
         self.imports: dict[str, dict[str, tuple[str, str]]] = {}
+        # per-module: top-level class names (receiver-type resolution)
+        self.classes: dict[str, set[str]] = {}
+        # (module, class) -> {attr: ctor repr}: `self.x = Foo(...)` seen in
+        # a method body.  Conflicting ctors for one attr map to None
+        # (ambiguous — resolution declines rather than guessing).
+        self._self_attr_ctors: dict[tuple[str, str], dict[str, str | None]] = {}
 
     # --- loading -------------------------------------------------------------
 
@@ -218,6 +292,30 @@ class Project:
             self.functions[(rel, fn.qualname)] = fn
             byname.setdefault(fn.qualname.rsplit(".", 1)[-1], []).append(fn)
         self.imports[rel] = _collect_imports(sf.tree, rel)
+        classes = self.classes.setdefault(rel, set())
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            classes.add(node.name)
+            attrs = self._self_attr_ctors.setdefault((rel, node.name), {})
+            for meth in node.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                ann = _param_annotations(meth)
+                for sub in ast.walk(meth):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    ctor = _ctor_repr_of(sub.value, ann)
+                    if ctor is None:
+                        continue
+                    for tgt in sub.targets:
+                        if (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            prev = attrs.get(tgt.attr, ctor)
+                            attrs[tgt.attr] = ctor if prev == ctor else None
         return sf
 
     def add_tree(self, subdir: str) -> None:
@@ -243,14 +341,29 @@ class Project:
             import from an analyzed module
           - self.X / cls.X -> method X in the same class, else any
             same-module function named X
-        Dotted chains through other objects are NOT resolved (no type
+          - self.X.Y -> method Y on the class CONSTRUCTED into self.X
+            (`self.x = Foo()` tracked per class; ISSUE 10 lifted the
+            PR 7 limit one level)
+        Deeper chains (self.a.b.c) are still NOT resolved (no type
         inference) — they are matched against the blocking-call tables
         directly instead."""
         mod = caller.module
         if callee.startswith(("self.", "cls.")):
             name = callee.split(".", 1)[1]
             if "." in name:
-                return None  # self.obj.method: untyped receiver
+                # self.obj.method: resolve through the ctor assignment
+                # recorded for obj on the caller's class, if unambiguous
+                attr, _, meth = name.partition(".")
+                if "." in meth:
+                    return None  # 3+ levels deep: untyped
+                cls = self._enclosing_class(caller)
+                if cls is None:
+                    return None
+                ctor = self._self_attr_ctors.get((mod, cls), {}).get(attr)
+                target = self._resolve_class(mod, ctor) if ctor else None
+                if target is None:
+                    return None
+                return self.functions.get((target[0], f"{target[1]}.{meth}"))
             cls = caller.qualname.rsplit(".", 1)[0] if "." in caller.qualname else None
             if cls:
                 hit = self.functions.get((mod, f"{cls}.{name}"))
@@ -287,6 +400,47 @@ class Project:
             for fn in self._by_name.get(target_mod, {}).get(orig, []):
                 if "." not in fn.qualname:
                     return fn
+        return None
+
+    def _enclosing_class(self, fn: FunctionInfo) -> str | None:
+        """The class a method belongs to: the first qualname component,
+        when it names a top-level class of the module (nested helpers
+        inside methods keep working — Class.method.inner -> Class)."""
+        head = fn.qualname.split(".", 1)[0]
+        return head if head in self.classes.get(fn.module, set()) else None
+
+    def _resolve_class(self, mod: str, ctor: str) -> tuple[str, str] | None:
+        """Resolve a constructor repr ('Foo', 'mod.Foo', 'Foo.new') to
+        (module relpath, class name) among analyzed files."""
+
+        def local_or_imported(name: str) -> tuple[str, str] | None:
+            if name in self.classes.get(mod, set()):
+                return (mod, name)
+            imp = self.imports.get(mod, {}).get(name)
+            if imp is not None and imp[1] != "*module*":
+                tmod, orig = imp
+                if orig in self.classes.get(tmod, set()):
+                    return (tmod, orig)
+            return None
+
+        if "." not in ctor:
+            return local_or_imported(ctor)
+        head, _, tail = ctor.partition(".")
+        if "." in tail:
+            return None
+        # `Foo.new(...)` classmethod constructor: the class is the head
+        hit = local_or_imported(head)
+        if hit is not None:
+            return hit
+        # `mod.Foo(...)` through an imported module
+        imp = self.imports.get(mod, {}).get(head)
+        if imp is not None:
+            tmod = (
+                imp[0] if imp[1] == "*module*"
+                else imp[0][:-3] + "/" + imp[1] + ".py"
+            )
+            if tail in self.classes.get(tmod, set()):
+                return (tmod, tail)
         return None
 
 
@@ -354,10 +508,24 @@ def analyze(
     root: str,
     paths: Iterable[str] = ("garage_tpu",),
     rules: Iterable[str] | None = None,
+    timings: dict[str, float] | None = None,
 ) -> list[Violation]:
     """Run all (or the selected) rule families over `paths` under `root`.
-    Returns unsuppressed violations sorted by (path, line)."""
-    from . import loop_blocker, orphan_task, resource, swallowed
+    Returns unsuppressed violations sorted by (path, line).  When a dict
+    is passed as `timings` it is filled with per-rule wall seconds
+    (served by `graft_lint.py --json`)."""
+    import time
+
+    from . import (
+        cancel_safety,
+        lock_await,
+        loop_blocker,
+        orphan_task,
+        resource,
+        swallowed,
+        taint,
+        wire_compat,
+    )
 
     project = Project(root)
     for p in paths:
@@ -368,6 +536,10 @@ def analyze(
         "orphan-task": orphan_task.check,
         "swallowed-exception": swallowed.check,
         "resource-discipline": resource.check,
+        "cancel-safety": cancel_safety.check,
+        "lock-await": lock_await.check,
+        "trust-boundary": taint.check,
+        "wire-compat": wire_compat.check,
     }
     selected = set(rules) if rules else set(all_rules)
     unknown = selected - set(all_rules)
@@ -376,7 +548,10 @@ def analyze(
 
     violations: list[Violation] = []
     for name in sorted(selected):
+        t0 = time.perf_counter()
         violations.extend(all_rules[name](project))
+        if timings is not None:
+            timings[name] = time.perf_counter() - t0
     violations.extend(_check_pragmas(project))
     violations.sort(key=lambda v: (v.path, v.line, v.rule, v.detail))
     return violations

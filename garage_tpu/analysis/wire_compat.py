@@ -1,0 +1,463 @@
+"""wire-compat: the gossip/RPC wire surface is schema-gated, not vibes.
+
+Until now the telemetry digest's key set was guarded by a comment
+("additive keys, DIGEST_VERSION stays 1") and the RPC frame meta keys by
+convention alone.  Removing or retyping either breaks rolling upgrades
+silently: an old peer reads a key that is gone and degrades (best case)
+or mis-parses (worst case).  This rule snapshots the wire surface into a
+committed schema file and fails drift:
+
+**schema snapshot** — ``script/wire_schema.json`` records (a) the
+``DIGEST_VERSION`` value, (b) every digest key (dotted for nesting, with
+a static type tag) extracted from ``DigestCollector.collect``'s dict
+literal, (c) the RPC frame meta keys from ``net/connection.py``'s
+``meta``/``rmeta`` literals, and (d) every ``Migratable`` subclass's
+``VERSION_MARKER`` and whether it declares a ``PREVIOUS`` migration hop.
+
+**drift checks** (all comparisons only run when the defining file is in
+the analyzed set, so subtree lints stay quiet):
+
+  - digest/frame key REMOVED or RETYPED with ``DIGEST_VERSION``
+    unchanged -> violation.  Added keys are clean (additive evolution).
+  - ``DIGEST_VERSION`` differing from the snapshot -> violation telling
+    you to regenerate (``script/graft_lint.py --write-wire-schema``):
+    a bump and its snapshot land in the same commit.
+  - a ``Migratable`` class disappearing, or changing its
+    ``VERSION_MARKER`` without declaring ``PREVIOUS`` -> violation
+    (persisted state written under the old marker becomes undecodable
+    with no migration chain).
+
+**crdt-mutation** — classes defining ``merge()`` under ``model/`` or
+``table/`` must only mutate ``self`` inside ``__init__``/
+``__post_init__``/``merge*``/``update*`` methods.  CRDT correctness
+(the paper's whole consistency story) rests on merge discipline: a
+mutation from any other method bypasses the idempotent/commutative
+merge path and diverges replicas.  Suppress with
+``# graft-lint: allow-wire(<reason>)`` on the assignment.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from .core import Project, Violation, call_repr
+
+RULE = "wire-compat"
+
+DIGEST_PATH = "garage_tpu/rpc/telemetry_digest.py"
+FRAME_PATH = "garage_tpu/net/connection.py"
+SCHEMA_PATH = "script/wire_schema.json"
+SCHEMA_VERSION = 1
+
+CRDT_ALLOWED_PREFIXES = ("merge", "update")
+CRDT_ALLOWED_NAMES = {"__init__", "__post_init__"}
+
+
+def _last(repr_: str) -> str:
+    return repr_.rsplit(".", 1)[-1]
+
+
+# --- static type tags ---------------------------------------------------------
+
+
+def _type_tag(node) -> str:
+    """A coarse, stable type tag for a dict-literal value.  'any' never
+    mismatches — only confidently-known tags participate in the retype
+    check."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int"
+        if isinstance(v, float):
+            return "float"
+        if isinstance(v, str):
+            return "str"
+        return "any"
+    if isinstance(node, ast.Dict):
+        return "object"
+    if isinstance(node, ast.Call):
+        r = call_repr(node.func) or ""
+        last = _last(r)
+        if last == "round":
+            return "number"
+        if last == "int":
+            return "int"
+        if last == "float":
+            return "number"
+        if last == "bool":
+            return "bool"
+        if last in ("str", "join", "hex", "format"):
+            return "str"
+    if isinstance(node, (ast.List, ast.ListComp)):
+        return "list"
+    if isinstance(node, ast.JoinedStr):
+        return "str"
+    return "any"
+
+
+def _flatten_dict(node: ast.Dict, prefix: str, into: dict[str, str]) -> None:
+    for k, v in zip(node.keys, node.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)):
+            continue  # dynamic keys are out of static reach
+        dotted = f"{prefix}{k.value}"
+        if isinstance(v, ast.Dict):
+            into[dotted] = "object"
+            _flatten_dict(v, dotted + ".", into)
+        else:
+            into[dotted] = _type_tag(v)
+
+
+# --- extraction ---------------------------------------------------------------
+
+
+def extract_digest(project: Project) -> tuple[int | None, dict[str, str]] | None:
+    """(DIGEST_VERSION, {dotted key: type tag}) from the digest module,
+    or None when it is not in the analyzed set."""
+    sf = project.files.get(DIGEST_PATH)
+    if sf is None:
+        return None
+    version: int | None = None
+    for node in sf.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "DIGEST_VERSION"
+            and isinstance(node.value, ast.Constant)
+        ):
+            version = int(node.value.value)
+    keys: dict[str, str] = {}
+    collect = None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "collect":
+            collect = node
+            break
+    if collect is not None:
+        # the literal assigned to `digest`, plus digest["k"] = ... adds
+        for node in ast.walk(collect):
+            if isinstance(node, ast.AnnAssign):  # digest: dict = {...}
+                t, value = node.target, node.value
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "digest"
+                    and isinstance(value, ast.Dict)
+                ):
+                    _flatten_dict(value, "", keys)
+                continue
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "digest"
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    _flatten_dict(node.value, "", keys)
+                elif (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "digest"
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    keys[t.slice.value] = _type_tag(node.value)
+    return version, keys
+
+
+def extract_frame_meta(project: Project) -> dict[str, str] | None:
+    """{meta key: type tag} from connection.py's meta/rmeta literals,
+    or None when the file is not in the analyzed set."""
+    sf = project.files.get(FRAME_PATH)
+    if sf is None:
+        return None
+    keys: dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if (
+            isinstance(t, ast.Name)
+            and t.id in ("meta", "rmeta")
+            and isinstance(node.value, ast.Dict)
+        ):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.setdefault(k.value, _type_tag(v))
+        elif (
+            isinstance(t, ast.Subscript)
+            and isinstance(t.value, ast.Name)
+            and t.value.id in ("meta", "rmeta")
+            and isinstance(t.slice, ast.Constant)
+            and isinstance(t.slice.value, str)
+        ):
+            keys.setdefault(t.slice.value, _type_tag(node.value))
+    return keys
+
+
+def extract_migratables(project: Project) -> dict[str, dict]:
+    """Every class with a bytes VERSION_MARKER: '<module>:<Class>' ->
+    {marker, has_previous}."""
+    out: dict[str, dict] = {}
+    for rel, sf in project.files.items():
+        for node in sf.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            marker = None
+            has_prev = False
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    t = stmt.targets[0]
+                    if isinstance(t, ast.Name) and t.id == "VERSION_MARKER":
+                        if isinstance(stmt.value, ast.Constant) and isinstance(
+                            stmt.value.value, bytes
+                        ):
+                            marker = stmt.value.value.decode("latin1")
+                    elif isinstance(t, ast.Name) and t.id == "PREVIOUS":
+                        has_prev = not (
+                            isinstance(stmt.value, ast.Constant)
+                            and stmt.value.value is None
+                        )
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    if stmt.target.id == "PREVIOUS" and stmt.value is not None:
+                        has_prev = not (
+                            isinstance(stmt.value, ast.Constant)
+                            and stmt.value.value is None
+                        )
+            if marker:  # the Migratable base's own b"" marker is not one
+                out[f"{rel}:{node.name}"] = {
+                    "marker": marker,
+                    "has_previous": has_prev,
+                }
+    return out
+
+
+def build_schema(project: Project) -> dict:
+    dig = extract_digest(project)
+    frame = extract_frame_meta(project)
+    return {
+        "version": SCHEMA_VERSION,
+        "generated_by": "script/graft_lint.py --write-wire-schema",
+        "digest_version": dig[0] if dig else None,
+        "digest_keys": dict(sorted(dig[1].items())) if dig else {},
+        "frame_meta_keys": dict(sorted(frame.items())) if frame else {},
+        "migratable_markers": dict(
+            sorted(extract_migratables(project).items())
+        ),
+    }
+
+
+def write_wire_schema(project: Project, path: str | None = None) -> dict:
+    schema = build_schema(project)
+    target = path or os.path.join(project.root, SCHEMA_PATH)
+    with open(target, "w", encoding="utf-8") as f:
+        json.dump(schema, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return schema
+
+
+# --- checks -------------------------------------------------------------------
+
+
+def check(project: Project) -> list[Violation]:
+    return _check_schema(project) + _check_crdt_mutation(project)
+
+
+def _load_schema(project: Project) -> dict | None:
+    p = os.path.join(project.root, SCHEMA_PATH)
+    try:
+        with open(p, encoding="utf-8") as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if raw.get("version") != SCHEMA_VERSION:
+        return None
+    return raw
+
+
+def _check_schema(project: Project) -> list[Violation]:
+    dig = extract_digest(project)
+    frame = extract_frame_meta(project)
+    if dig is None and frame is None:
+        return []  # wire-defining files outside the analyzed set
+    schema = _load_schema(project)
+    if schema is None:
+        path = DIGEST_PATH if dig is not None else FRAME_PATH
+        return [
+            Violation(
+                RULE, path, 1, "<module>", "wire-schema:missing",
+                f"{SCHEMA_PATH} is missing or unreadable: the wire "
+                "surface (digest keys, frame meta keys, Migratable "
+                "markers) must be snapshot-gated — run "
+                "`python script/graft_lint.py --write-wire-schema` "
+                "and commit the file",
+            )
+        ]
+    out: list[Violation] = []
+    if dig is not None:
+        version, keys = dig
+        if version != schema.get("digest_version"):
+            out.append(
+                Violation(
+                    RULE, DIGEST_PATH, 1, "<module>",
+                    "wire-schema:version-drift",
+                    f"DIGEST_VERSION is {version} but "
+                    f"{SCHEMA_PATH} snapshots "
+                    f"{schema.get('digest_version')}: a version bump "
+                    "and its schema snapshot belong in the same commit "
+                    "— re-run --write-wire-schema",
+                )
+            )
+        else:
+            for key, tag in sorted(schema.get("digest_keys", {}).items()):
+                if key not in keys:
+                    out.append(
+                        Violation(
+                            RULE, DIGEST_PATH, 1, "DigestCollector.collect",
+                            f"digest-key-removed:{key}",
+                            f"digest key {key!r} was removed without a "
+                            "DIGEST_VERSION bump: old peers still parse "
+                            "it — bump DIGEST_VERSION and re-run "
+                            "--write-wire-schema",
+                        )
+                    )
+                elif (
+                    tag != "any"
+                    and keys[key] != "any"
+                    and keys[key] != tag
+                ):
+                    out.append(
+                        Violation(
+                            RULE, DIGEST_PATH, 1, "DigestCollector.collect",
+                            f"digest-key-retyped:{key}",
+                            f"digest key {key!r} changed type "
+                            f"{tag} -> {keys[key]} without a "
+                            "DIGEST_VERSION bump — bump it and re-run "
+                            "--write-wire-schema",
+                        )
+                    )
+    if frame is not None and (
+        dig is None or dig[0] == schema.get("digest_version")
+    ):
+        for key, tag in sorted(schema.get("frame_meta_keys", {}).items()):
+            if key not in frame:
+                out.append(
+                    Violation(
+                        RULE, FRAME_PATH, 1, "<module>",
+                        f"frame-meta-removed:{key}",
+                        f"RPC frame meta key {key!r} disappeared from "
+                        "connection.py: old peers still read it — "
+                        "restore it, or bump DIGEST_VERSION (the wire "
+                        "era marker) and re-run --write-wire-schema",
+                    )
+                )
+            elif tag != "any" and frame[key] != "any" and frame[key] != tag:
+                out.append(
+                    Violation(
+                        RULE, FRAME_PATH, 1, "<module>",
+                        f"frame-meta-retyped:{key}",
+                        f"RPC frame meta key {key!r} changed type "
+                        f"{tag} -> {frame[key]} — bump DIGEST_VERSION "
+                        "and re-run --write-wire-schema",
+                    )
+                )
+    cur_migr = extract_migratables(project)
+    for name, info in sorted(schema.get("migratable_markers", {}).items()):
+        mod = name.split(":", 1)[0]
+        if mod not in project.files:
+            continue  # subtree lint: defining module not analyzed
+        cur = cur_migr.get(name)
+        if cur is None:
+            out.append(
+                Violation(
+                    RULE, mod, 1, "<module>",
+                    f"migratable-removed:{name.split(':', 1)[1]}",
+                    f"Migratable {name} disappeared: state persisted "
+                    f"under marker {info['marker']!r} becomes "
+                    "undecodable — keep the class (it may delegate via "
+                    "PREVIOUS) or migrate the on-disk format first",
+                )
+            )
+        elif cur["marker"] != info["marker"] and not cur["has_previous"]:
+            out.append(
+                Violation(
+                    RULE, mod, 1, "<module>",
+                    f"migratable-marker-changed:{name.split(':', 1)[1]}",
+                    f"Migratable {name} changed VERSION_MARKER "
+                    f"{info['marker']!r} -> {cur['marker']!r} without "
+                    "declaring PREVIOUS: old persisted state has no "
+                    "migration chain — set PREVIOUS to the old-format "
+                    "class, then re-run --write-wire-schema",
+                )
+            )
+    return out
+
+
+# --- crdt-mutation ------------------------------------------------------------
+
+
+def _crdt_scope(rel: str) -> bool:
+    p = "/" + rel
+    return "/model/" in p or "/table/" in p
+
+
+def _method_allowed(name: str) -> bool:
+    return name in CRDT_ALLOWED_NAMES or name.startswith(CRDT_ALLOWED_PREFIXES)
+
+
+def _check_crdt_mutation(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for rel, sf in project.files.items():
+        if not _crdt_scope(rel):
+            continue
+        for cls in sf.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            meths = {
+                n.name
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            if "merge" not in meths:
+                continue
+            for meth in cls.body:
+                if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if _method_allowed(meth.name):
+                    continue
+                for sub in ast.walk(meth):
+                    if isinstance(sub, ast.Assign):
+                        targets = sub.targets
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [sub.target]
+                    else:
+                        continue
+                    for t in targets:
+                        if not (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            continue
+                        if sf.pragma_for(sub, "wire"):
+                            continue
+                        out.append(
+                            Violation(
+                                RULE, rel, sub.lineno,
+                                f"{cls.name}.{meth.name}",
+                                f"crdt-mutation:{cls.name}.{meth.name}:"
+                                f"{t.attr}",
+                                f"CRDT {cls.name} mutates self.{t.attr} "
+                                f"in {meth.name}(): state on a "
+                                "merge()-bearing class may only change "
+                                "in __init__/merge*/update* — any other "
+                                "mutation bypasses merge discipline and "
+                                "diverges replicas — or "
+                                "# graft-lint: allow-wire(<reason>)",
+                            )
+                        )
+    return out

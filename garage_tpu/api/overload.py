@@ -240,6 +240,7 @@ class AdmissionController:
                 "api_admission_tenant_evictions_total", (("kind", label),)
             )
         table[ident] = b
+        # graft-lint: allow-taint(claimed pre-auth id as a label value is by design — metrics._fmt applies _esc to EVERY label at exposition, so a hostile id cannot corrupt the scrape)
         self.registry.register_gauge(
             gauge, ((label, ident), ("id", self._gauge_id)), b.level
         )

@@ -574,11 +574,18 @@ async def plain_block_stream(garage, blocks, start: int, end: int, enc_params):
         pending = [t for t in live if not t.done()]
         for t in pending:
             t.cancel()
-        if pending:
-            await asyncio.gather(*pending, return_exceptions=True)
-        for t in live:  # silence never-retrieved warnings on teardown
-            if t.done() and not t.cancelled():
-                t.exception()
+
+        async def _drain_and_sweep(cancelled, started):
+            if cancelled:
+                await asyncio.gather(*cancelled, return_exceptions=True)
+            for t in started:  # silence never-retrieved warnings
+                if t.done() and not t.cancelled():
+                    t.exception()
+
+        # ONE shielded coroutine for drain + sweep: a cancel landing
+        # mid-drain re-raises at this await but the sweep still runs to
+        # completion in the shielded task (graft-lint cancel-safety)
+        await asyncio.shield(_drain_and_sweep(pending, live))
 
 
 def _parse_part_number(request) -> int | None:

@@ -85,8 +85,9 @@ def _file_stream(path: str, chunk: int = 256 * 1024):
             # close in a thread too: after a cancelled read, close()
             # blocks on the BufferedReader lock until the in-flight disk
             # read finishes — on the loop that would be exactly the stall
-            # this function exists to avoid
-            await asyncio.to_thread(f.close)
+            # this function exists to avoid.  Shielded so a cancel
+            # delivered mid-close can't abandon the fd (cancel-safety).
+            await asyncio.shield(asyncio.to_thread(f.close))
 
     return gen()
 
@@ -346,7 +347,7 @@ class BlockManager:
             from ..net.fault import InjectedDiskFault
 
             raise InjectedDiskFault("injected block write fault")
-        async with self._locks[hash32[0]]:
+        async with self._locks[hash32[0]]:  # graft-lint: allow-lock-await(per-prefix write lock intentionally spans the threaded write: shard serialization is the contract (ISSUE 10 known-intended case))
             existing = self.find_block_file(hash32, piece=piece)
             if existing is not None:
                 ex_path, ex_comp = existing
@@ -699,7 +700,12 @@ class BlockManager:
         except asyncio.TimeoutError:
             pass
         if not satisfied():
-            sender.cancel()
+            from ..utils.aio import reap
+
+            # cancel AND drain: a bare cancel() returns while the sender
+            # still holds stream buffers and its in-flight RPCs race the
+            # resync queueing below (graft-lint cancel-safety)
+            await reap([sender], log=logger, what="ec-put sender")
             got = min((distinct_ok(vt) for vt in per_version), default=0)
             raise Quorum(quorum_pieces, got, errors)
         # pieces not yet confirmed on their primary node heal via resync.

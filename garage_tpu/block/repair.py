@@ -166,9 +166,9 @@ class ScrubWorker(Worker):
         if n == 0:
             # cycle complete: restart from the beginning after a long rest
             self.state.cursor = b""
-            self._save()
+            await self._save_async()
             return (WorkerState.THROTTLED, 3600.0)
-        self._save()
+        await self._save_async()
         delay = self.tranquilizer.tranquilize_delay(self.state.tranquility)
         return (WorkerState.THROTTLED, max(delay, 0.05))
 
@@ -240,6 +240,12 @@ class ScrubWorker(Worker):
     def _save(self):
         if self.persister:
             self.persister.save(self.state)
+
+    async def _save_async(self):
+        # work()-path checkpoints fsync off the event loop (loop-blocker);
+        # the sync _save stays for the operator cmd_* one-shots
+        if self.persister:
+            await self.persister.save_in_thread(self.state)
 
 
 class RebalanceWorker(Worker):

@@ -349,7 +349,7 @@ class RepairPlanner(Worker):
 
     async def work(self):
         if self._cancel and not self.finished:
-            return self._finish("cancelled")
+            return await self._finish("cancelled")
         if self.finished:
             return WorkerState.DONE
         self.tranquilizer.reset()
@@ -359,17 +359,17 @@ class RepairPlanner(Worker):
             if not more and self.plan.state == "scanning":
                 self.plan.state = "repairing" if self.plan.ledger else "done"
             if not more or self._scan_steps % SCAN_CHECKPOINT_EVERY == 0:
-                self._save()
+                await self._save_async()
             if self.plan.state == "done":
-                return self._finish("done")
+                return await self._finish("done")
             return self._throttle()
         if self.plan.state == "repairing":
             if not self.plan.ledger:
-                return self._finish("done")
+                return await self._finish("done")
             picked = await self._repair_round()
-            self._save()
+            await self._save_async()
             if not self.plan.ledger:
-                return self._finish("done")
+                return await self._finish("done")
             if picked == 0:
                 # everything pickable sits behind open breakers: wait for
                 # half-open probes rather than spinning; after too long,
@@ -384,11 +384,11 @@ class RepairPlanner(Worker):
                         len(self.plan.ledger), self._defer_rounds,
                     )
                     self.plan.ledger = []
-                    return self._finish("done")
+                    return await self._finish("done")
                 return (WorkerState.THROTTLED, DEFER_RETRY_SECS)
             self._defer_rounds = 0
             return self._throttle()
-        return self._finish(self.plan.state or "done")
+        return await self._finish(self.plan.state or "done")
 
     def _throttle(self):
         delay = self.tranquilizer.tranquilize_delay(self.params.tranquility)
@@ -614,13 +614,15 @@ class RepairPlanner(Worker):
 
     # --- persistence / lifecycle ----------------------------------------------
 
-    def _save(self) -> None:
+    async def _save_async(self) -> None:
+        # work()-path checkpoints go off-loop: a plan ledger fsync on the
+        # event loop stalls every concurrent request (loop-blocker)
         if self.persister is not None:
-            self.persister.save(self.plan)
+            await self.persister.save_in_thread(self.plan)
 
-    def _finish(self, state: str):
+    async def _finish(self, state: str):
         self.plan.state = state
-        self._save()
+        await self._save_async()
         self._unregister_gauges()
         self.finished = True
         logger.info(

@@ -320,6 +320,7 @@ async def offline_repair(args) -> None:
                 "running daemon)"
             )
     finally:
+        # graft-lint: allow-cancel(one-shot CLI: process exits right after; a ctrl-C mid-teardown is an acceptable partial stop)
         await garage.stop()
 
 
@@ -417,6 +418,7 @@ async def run_cli(args) -> None:
         if out is not None:
             print(out)
     finally:
+        # graft-lint: allow-cancel(one-shot CLI: process exits right after; a ctrl-C mid-teardown is an acceptable partial stop)
         await app.shutdown()
 
 
@@ -593,6 +595,7 @@ async def dispatch(args, call, config) -> str | None:
                 # clear screen + home, like top(1)
                 print("\x1b[2J\x1b[H" + frame, flush=True)
                 await asyncio.sleep(max(0.2, args.interval))
+        # graft-lint: allow-cancel(interactive top loop: ctrl-C is the exit gesture, the CLI returns to the shell)
         except (KeyboardInterrupt, asyncio.CancelledError):
             return None
 

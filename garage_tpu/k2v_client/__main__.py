@@ -178,6 +178,7 @@ async def run(args) -> int:
         print(f"error {e.status}: {e}", file=sys.stderr)
         return 1
     finally:
+        # graft-lint: allow-cancel(one-shot CLI: process exits right after teardown)
         await client.close()
 
 

@@ -84,13 +84,13 @@ class LifecycleWorker(Worker):
             self.state.cursor = key + b"\x00"
             n += 1
             if n >= BATCH:
-                self._save()
+                await self._save_async()
                 return WorkerState.BUSY
         # pass complete
         self.state.last_completed = _today(use_local)
         self.state.cursor = b""
         self._bucket_cache.clear()
-        self._save()
+        await self._save_async()
         return WorkerState.IDLE
 
     async def wait_for_work(self) -> None:
@@ -176,6 +176,7 @@ class LifecycleWorker(Worker):
                             )
                             logger.info("lifecycle: aborted stale mpu on %s", obj.key)
 
-    def _save(self):
+    async def _save_async(self):
+        # work()-path checkpoints fsync off the event loop (loop-blocker)
         if self.persister:
-            self.persister.save(self.state)
+            await self.persister.save_in_thread(self.state)

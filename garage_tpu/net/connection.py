@@ -394,12 +394,22 @@ class Connection:
                         self._send_queues[nxt.level].put_nowait(nxt)
                         continue
                 self._send_queues[lvl].put_nowait(out)
-        except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            # close() cancelled us: teardown runs in the finally, then
+            # the cancel propagates so the task ends *cancelled* (a
+            # swallowed cancel made close()'s reap believe the loop
+            # finished on its own — graft-lint cancel-safety)
+            raise
+        except (ConnectionResetError, BrokenPipeError):
             pass
         except Exception as e:
             logger.warning("send loop error: %r", e)
         finally:
-            await self._teardown()
+            # shielded: a cancel landing while teardown itself is
+            # suspended must not abandon it half-way (pending RPC
+            # futures would never resolve and breakers stay pinned
+            # open for the whole adaptive timeout)
+            await asyncio.shield(self._teardown())
 
     # --- receiving -----------------------------------------------------------
 
@@ -450,16 +460,15 @@ class Connection:
                                 await st["writer"].close("cancelled by peer")
                             if st.get("task"):
                                 st["task"].cancel()
-        except (
-            asyncio.IncompleteReadError,
-            ConnectionResetError,
-            asyncio.CancelledError,
-        ):
+        except asyncio.CancelledError:
+            raise  # see _send_loop: teardown in finally, end cancelled
+        except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         except Exception as e:
             logger.warning("recv loop error: %r", e)
         finally:
-            await self._teardown()
+            # shielded for the same reason as _send_loop's teardown
+            await asyncio.shield(self._teardown())
 
     async def _on_body(self, rid: int, flags: int, payload: bytes) -> None:
         if not self._rid_is_mine(rid):
@@ -567,8 +576,11 @@ class Connection:
                 K_RESP_META, rid, rmeta, _pack(resp.body), resp.stream, credit
             )
         except asyncio.CancelledError:
+            # peer abort (K_CANCEL) or teardown cancelled the handler:
+            # drop the request state, then end *cancelled* so the
+            # supervisor sees a cancelled task, not a completed one
             self._incoming.pop(rid, None)
-            return
+            raise
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             logger.debug("handler error for %s: %r", meta.get("ep"), e)
             frames = _frames_of(

@@ -24,6 +24,7 @@ from ..utils.serde import pack
 from ..net.netapp import NetApp
 from ..net.peering import PeeringManager
 from ..utils.migrate import Migratable
+from ..utils.persister import Persister
 from .layout.manager import LayoutManager
 from .layout.types import N_PARTITIONS
 from .replication_mode import ReplicationMode
@@ -116,7 +117,9 @@ class System:
         layout_manager: LayoutManager,
         replication_mode: ReplicationMode,
         bootstrap: list[tuple[bytes, tuple[str, int]]] | None = None,
-        peer_persister=None,
+        # the annotation doubles as the analyzer's receiver-type source:
+        # `self.peer_persister.save` resolves into Persister (ISSUE 10)
+        peer_persister: Persister | None = None,
         metadata_dir: str | None = None,
         data_dirs: list[str] | None = None,
         public_addr: tuple[str, int] | None = None,
@@ -356,7 +359,12 @@ class System:
                         for p in self.peering.peers.values()
                         if p.addr is not None
                     ]
-                    self.peer_persister.save(PersistedPeers(peers))
+                    # off-loop: the peer-list fsync used to run on the
+                    # event loop every discovery tick (loop-blocker,
+                    # visible only since receiver-type resolution)
+                    await self.peer_persister.save_in_thread(
+                        PersistedPeers(peers)
+                    )
                 await self._external_discovery()
             except Exception:  # noqa: BLE001
                 logger.exception("discovery loop error")

@@ -216,7 +216,10 @@ class BackgroundRunner:
                     except asyncio.TimeoutError:
                         pass
             except asyncio.CancelledError:
-                return
+                # shutdown cancelled us: end *cancelled* (not "done") so
+                # reap/wait-side accounting sees a cancelled worker; the
+                # runner's finally still unregisters the gauges
+                raise
             except Exception as e:  # noqa: BLE001 — supervisor must survive
                 info.errors += 1
                 info.consecutive_errors += 1

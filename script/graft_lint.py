@@ -4,11 +4,17 @@
     python script/graft_lint.py                      # lint garage_tpu/
     python script/graft_lint.py garage_tpu/block     # lint a subtree
     python script/graft_lint.py --rules loop-blocker # one rule family
+    python script/graft_lint.py --diff origin/main   # changed files only
     python script/graft_lint.py --write-baseline     # re-triage debt
+    python script/graft_lint.py --write-wire-schema  # snapshot the wire
     python script/graft_lint.py --json               # machine-readable
 
 Exit codes: 0 clean (every finding is baselined), 1 new violations (or,
 with --strict, stale baseline entries), 2 usage error.
+
+`--diff [REF]` (default HEAD) lints only the .py files changed vs the
+git ref — the fast pre-commit loop; the full-repo run stays the tier-1
+gate.  `--json` output includes per-rule wall timings.
 
 The committed baseline (script/lint_baseline.json) is triaged debt:
 pre-existing findings stay visible there without failing the gate, new
@@ -21,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -36,6 +43,45 @@ from garage_tpu.analysis.core import (  # noqa: E402
 DEFAULT_BASELINE = os.path.join(REPO, "script", "lint_baseline.json")
 DEFAULT_PATHS = ["garage_tpu"]
 
+# always analyzed in --diff mode: the knob rule needs the config-section
+# inventory even when config.py itself didn't change
+DIFF_EXTRA = ["garage_tpu/utils/config.py"]
+
+
+def _changed_paths(ref: str) -> list[str] | None:
+    """Repo-relative .py files changed vs `ref` — UNION of `git diff`
+    (tracked edits) and `git ls-files --others` (brand-new files, which
+    git diff never lists and which are exactly the violation-prone
+    case) — plus DIFF_EXTRA.  None on a git error.  Deleted files are
+    excluded — there is nothing left to lint."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=d", ref,
+             "--", "*.py"],
+            capture_output=True, text=True, cwd=REPO, check=True,
+        ).stdout
+        out += subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--", "*.py"],
+            capture_output=True, text=True, cwd=REPO, check=True,
+        ).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        msg = getattr(e, "stderr", "") or str(e)
+        print(f"graft-lint: git diff {ref} failed: {msg.strip()}",
+              file=sys.stderr)
+        return None
+    changed = sorted({
+        p for p in out.splitlines()
+        if p.startswith(tuple(f"{d}/" for d in DEFAULT_PATHS))
+        and os.path.exists(os.path.join(REPO, p))
+    })
+    if not changed:
+        return []
+    for extra in DIFF_EXTRA:
+        if extra not in changed and os.path.exists(os.path.join(REPO, extra)):
+            changed.append(extra)
+    return sorted(changed)
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
@@ -50,17 +96,60 @@ def main(argv: list[str] | None = None) -> int:
                     help="write the current findings as the new baseline")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule families (default: all)")
+    ap.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files changed vs the git REF "
+                         "(default HEAD) — fast pre-commit loop")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as JSON")
+                    help="emit findings as JSON (includes per-rule "
+                         "timings)")
     ap.add_argument("--strict", action="store_true",
                     help="also fail on stale baseline entries (debt that "
                          "was paid but not re-triaged)")
+    ap.add_argument("--write-wire-schema", action="store_true",
+                    help="snapshot the wire surface (digest keys, frame "
+                         "meta keys, Migratable markers) into "
+                         "script/wire_schema.json")
     args = ap.parse_args(argv)
+
+    if args.write_wire_schema:
+        # needs only a Project over the full tree, not an analysis pass
+        from garage_tpu.analysis.core import Project
+        from garage_tpu.analysis.wire_compat import (
+            SCHEMA_PATH,
+            write_wire_schema,
+        )
+
+        project = Project(REPO)
+        for p in DEFAULT_PATHS:
+            project.add_tree(p)
+        schema = write_wire_schema(project)
+        print(f"graft-lint: wrote {len(schema['digest_keys'])} digest "
+              f"key(s), {len(schema['frame_meta_keys'])} frame meta "
+              f"key(s), {len(schema['migratable_markers'])} Migratable "
+              f"marker(s) to {SCHEMA_PATH}")
+        return 0
 
     rules = args.rules.split(",") if args.rules else None
     paths = args.paths or DEFAULT_PATHS
+    if args.diff is not None:
+        if args.write_baseline:
+            # a baseline written from a file subset would silently drop
+            # every entry for unchanged files — the next full run then
+            # reports all that debt as NEW and fails the gate
+            print("graft-lint: --diff and --write-baseline are mutually "
+                  "exclusive (the baseline must cover the full tree)",
+                  file=sys.stderr)
+            return 2
+        paths = _changed_paths(args.diff)
+        if paths is None:
+            return 2
+        if not paths:
+            print(f"graft-lint: no analyzable files changed vs {args.diff}")
+            return 0
+    timings: dict[str, float] = {}
     try:
-        violations = analyze(REPO, paths, rules)
+        violations = analyze(REPO, paths, rules, timings=timings)
     except ValueError as e:
         print(f"graft-lint: {e}", file=sys.stderr)
         return 2
@@ -90,6 +179,7 @@ def main(argv: list[str] | None = None) -> int:
             "new": [v.__dict__ | {"key": v.key} for v in new],
             "baselined": len(violations) - len(new),
             "stale_baseline_keys": stale,
+            "timings": {k: round(t, 4) for k, t in sorted(timings.items())},
         }, indent=2))
     else:
         for v in new:

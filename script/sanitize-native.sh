@@ -23,8 +23,34 @@
 #   ./script/sanitize-native.sh --ubsan  Same smoke under
 #       -fsanitize=undefined only (signed overflow, misaligned loads in
 #       the frame parser).
+#
+#   ./script/sanitize-native.sh --all    tsan + asan + ubsan in sequence
+#       (each in a fresh child so the LD_PRELOAD runtimes never mix),
+#       then one summary table.  Exit 1 if any mode failed.
 set -e
 cd "$(dirname "$0")/.."
+
+if [ "$1" = "--all" ]; then
+    self="$0"
+    overall=0
+    results=""
+    for mode in tsan asan ubsan; do
+        start=$(date +%s)
+        if "$self" "--$mode" >/tmp/sanitize_${mode}.log 2>&1; then
+            status=PASS
+        else
+            status=FAIL
+            overall=1
+        fi
+        secs=$(( $(date +%s) - start ))
+        results="${results}${mode}\t${status}\t${secs}s\t/tmp/sanitize_${mode}.log\n"
+    done
+    printf '\n=== sanitize-native summary ===\n'
+    printf 'MODE\tRESULT\tTIME\tLOG\n'
+    printf "%b" "$results"
+    [ "$overall" -ne 0 ] && printf 'one or more sanitizer modes FAILED — see logs above\n'
+    exit $overall
+fi
 
 # --asan / --ubsan: single-sanitizer builds + the kvlog group-commit
 # smoke (mirrors --tsan's shape: one mode flag, one focused workload)

@@ -1,0 +1,64 @@
+"""Negative fixture: cancel-safety must fire on all three sub-rules.
+
+Deliberately broken teardown patterns (plus good variants that must stay
+quiet).  Never imported — parsed by the analyzer only.
+"""
+
+import asyncio
+
+
+async def finally_awaiter(conn):
+    try:
+        await conn.send()
+    finally:
+        await conn.teardown()  # finally-await: fires
+
+
+async def finally_shielded(conn, reap):
+    try:
+        await conn.send()
+    finally:
+        await asyncio.shield(conn.teardown())  # shielded: quiet
+        await reap([])  # reap: quiet
+
+
+async def swallower(worker):
+    try:
+        await worker.run()
+    except asyncio.CancelledError:
+        pass  # cancelled-swallowed: fires
+
+
+async def reraiser(worker):
+    try:
+        await worker.run()
+    except asyncio.CancelledError:
+        await worker.cleanup()
+        raise  # re-raised: quiet
+
+
+async def canceller(tasks):
+    for t in tasks:
+        t.cancel()  # cancel-no-drain: fires (nothing drains `tasks`)
+    return None
+
+
+async def drainer(tasks):
+    for t in tasks:
+        t.cancel()
+    await asyncio.gather(*tasks, return_exceptions=True)  # drained: quiet
+
+
+async def alias_drainer(tasks):
+    for t in tasks:
+        t.cancel()
+    waits = [t for t in tasks if not t.done()]
+    await asyncio.gather(*waits)  # drained through the alias: quiet
+
+
+async def stop_pattern(owner):
+    owner.task.cancel()
+    try:
+        await owner.task  # caller-side drain of another task
+    except asyncio.CancelledError:
+        pass  # standard drain pattern: quiet

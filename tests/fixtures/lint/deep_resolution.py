@@ -1,0 +1,29 @@
+"""Deeper-resolution fixture: `self.persister.save(...)` resolves into
+the class constructed in __init__ (PR 7 documented this exact shape as
+unreachable; ISSUE 10 lifts the limit one level).
+
+Never imported — parsed by the analyzer only.
+"""
+
+
+class FilePersister:
+    def save(self, data):
+        # blocking: reachable only through receiver-type resolution
+        with open("/tmp/deep_resolution_fixture", "wb") as f:
+            f.write(data)
+
+
+class Planner:
+    def __init__(self, enabled: bool):
+        self.persister = FilePersister() if enabled else None
+        self.annotated = None
+
+    def adopt(self, p: "FilePersister | None"):
+        # annotation-based tracking: `self.annotated.save` resolves too
+        self.annotated = p
+
+    async def checkpoint(self, data):
+        self.persister.save(data)  # loop-blocker must fire (ctor)
+
+    async def checkpoint_annotated(self, data):
+        self.annotated.save(data)  # loop-blocker must fire (annotation)

@@ -1,0 +1,40 @@
+"""Negative fixture: lock-await must fire on slow awaits under a mutex.
+
+Never imported — parsed by the analyzer only.
+"""
+
+import asyncio
+
+
+class Api:
+    def __init__(self):
+        self.lock = asyncio.Lock()
+        self.sem = asyncio.Semaphore(4)
+
+    async def bad_rpc_under_lock(self, helper, node, req):
+        async with self.lock:
+            return await helper.call(node, req)  # fires: RPC under lock
+
+    async def bad_wait_under_lock(self, ev):
+        async with self.lock:
+            await ev.wait()  # fires: unbounded wait under lock
+
+    async def _do_rpc(self, helper, node, req):
+        return await helper.call(node, req)
+
+    async def bad_resolved_rpc(self, helper, node, req):
+        async with self.lock:
+            # fires: resolves into _do_rpc -> helper.call
+            return await self._do_rpc(helper, node, req)
+
+    async def ok_compute_under_lock(self):
+        async with self.lock:
+            return sum(range(10))  # pure compute: quiet
+
+    async def ok_semaphore(self, helper, node, req):
+        async with self.sem:  # capacity bound, not a mutex: quiet
+            return await helper.call(node, req)
+
+    async def ok_pragma(self, helper, node, req):
+        async with self.lock:  # graft-lint: allow-lock-await(fixture: reasoned hold covering the whole body)
+            return await helper.call(node, req)

@@ -1,0 +1,20 @@
+"""Negative fixture: wire-compat crdt-mutation (path contains /model/ on
+purpose — the sub-rule only scopes to model// table/ trees).
+
+Never imported — parsed by the analyzer only.
+"""
+
+
+class BadRegister:
+    def __init__(self, value):
+        self.value = value  # __init__: allowed
+
+    def merge(self, other):
+        if other.value > self.value:
+            self.value = other.value  # merge: allowed
+
+    def update(self, v):
+        self.value = v  # update*: allowed
+
+    def sneaky_set(self, v):
+        self.value = v  # fires: mutation outside merge/update discipline

@@ -1,0 +1,52 @@
+"""Negative fixture: trust-boundary must fire on raw pre-auth values.
+
+Never imported — parsed by the analyzer only (`_esc` is deliberately
+undefined: the analyzer matches names, it never executes this).
+"""
+
+import os
+
+
+class Admission:
+    def __init__(self, registry):
+        self.registry = registry
+
+    def claimed_key_id(self, request):
+        return request.headers.get("Authorization")
+
+    def bad_label(self, request):
+        key_id = self.claimed_key_id(request)
+        self.registry.register_gauge(
+            "tenant_tokens", (("id", key_id),), 1.0  # fires: raw label
+        )
+
+    def bad_log(self, request, logger):
+        key_id = self.claimed_key_id(request)
+        logger.warning(f"tenant {key_id} over budget")  # fires: f-string
+
+    def bad_path(self, request):
+        key_id = self.claimed_key_id(request)
+        return os.path.join("/tmp", key_id)  # fires: path sink
+
+    def bad_digest_label(self, status):
+        dig = status.telemetry  # gossiped digest: source
+        self.registry.set_gauge("peer_lag", (("d", dig),), 1.0)  # fires
+
+    def _register(self, tid):
+        # fires WITH Admission._register as the symbol when reached
+        # through the tainted one-hop below
+        self.registry.register_gauge("hop_tokens", (("id", tid),), 1.0)
+
+    def bad_hop(self, request):
+        key_id = self.claimed_key_id(request)
+        self._register(key_id)
+
+    def ok_escaped(self, request):
+        key_id = self.claimed_key_id(request)
+        self.registry.register_gauge(
+            "tenant_tokens", (("id", _esc(key_id)),), 1.0  # noqa: F821
+        )
+
+    def ok_percent_log(self, request, logger):
+        key_id = self.claimed_key_id(request)
+        logger.warning("tenant %s over budget", key_id)  # %-style: quiet

@@ -161,6 +161,207 @@ def test_fixture_unvalidated_knob_fires():
     # the ==1 above: the fixture contains both)
 
 
+def test_fixture_cancel_safety_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/cancel_unsafe.py")
+        if v.rule == "cancel-safety"
+    ]
+    details = {v.detail for v in vs}
+    symbols = {v.symbol for v in vs}
+    # all three sub-rules fire
+    assert "finally-await:conn.teardown" in details
+    assert "cancelled-swallowed" in details
+    assert any(d.startswith("cancel-no-drain:") for d in details)
+    # good variants stay quiet: shield/reap finally, re-raise handler,
+    # gather drain, alias drain, caller-side drain-of-another-task
+    assert symbols == {"finally_awaiter", "swallower", "canceller"}
+
+
+def test_fixture_lock_await_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/lock_rpc.py") if v.rule == "lock-await"
+    ]
+    symbols = {v.symbol for v in vs}
+    assert symbols == {
+        "Api.bad_rpc_under_lock",
+        "Api.bad_wait_under_lock",
+        "Api.bad_resolved_rpc",  # via name-resolved helper hop
+    }
+    # semaphores, pure compute, and the pragma'd hold stay quiet
+    assert "Api.ok_semaphore" not in symbols
+    assert "Api.ok_pragma" not in symbols
+
+
+def test_fixture_taint_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/tainted_label.py")
+        if v.rule == "trust-boundary"
+    ]
+    details = {v.detail for v in vs}
+    assert "metric:register_gauge:key_id" in details  # raw label
+    assert "log:warning:key_id" in details  # f-string log
+    assert "path:join:key_id" in details  # filesystem sink
+    assert "metric:set_gauge:dig" in details  # gossiped digest source
+    # the one-hop interprocedural flow lands on the callee's gauge call
+    assert "metric:register_gauge:tid" in details
+    # _esc-wrapped label and %-style logging stay quiet
+    symbols = {v.symbol for v in vs}
+    assert "Admission.ok_escaped" not in symbols
+    assert "Admission.ok_percent_log" not in symbols
+
+
+def test_fixture_deep_resolution_fires():
+    """PR 7's documented limit — `self.persister.save(...)` invisible to
+    the loop-blocker — is lifted: constructor AND annotation-tracked
+    receivers resolve into the target class."""
+    vs = [
+        v for v in lint(f"{FIXTURES}/deep_resolution.py")
+        if v.rule == "loop-blocker"
+    ]
+    assert {v.symbol for v in vs} == {
+        "Planner.checkpoint",  # self.persister = FilePersister() if ...
+        "Planner.checkpoint_annotated",  # p: "FilePersister | None"
+    }
+    assert all("FilePersister.save" in v.detail for v in vs)
+
+
+def test_fixture_crdt_mutation_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/model/bad_crdt.py")
+        if v.rule == "wire-compat"
+    ]
+    assert len(vs) == 1
+    assert vs[0].symbol == "BadRegister.sneaky_set"
+    # __init__/merge/update mutations are the allowed discipline
+    assert "sneaky_set" in vs[0].detail
+
+
+# --- wire-schema drift --------------------------------------------------------
+
+
+DIGEST_SRC = '''\
+DIGEST_VERSION = {version}
+
+class DigestCollector:
+    def collect(self):
+        digest = {{
+            "v": DIGEST_VERSION,
+            "up": 1.0,
+            "s3": {{{s3_keys}}},
+        }}
+        return digest
+'''
+
+FRAME_SRC = '''\
+async def call(endpoint):
+    meta = {{{meta_keys}}}
+    return meta
+'''
+
+MIGR_SRC = '''\
+class Persisted:
+    VERSION_MARKER = b"{marker}"
+    PREVIOUS = {previous}
+'''
+
+
+def _write_wire_tree(root, *, version=1, s3_keys='"rps": 1.0, "req": 7',
+                     meta_keys='"ep": "x", "prio": 0',
+                     marker="T0thing", previous="None"):
+    import pathlib
+
+    root = pathlib.Path(root)
+    (root / "garage_tpu/rpc").mkdir(parents=True, exist_ok=True)
+    (root / "garage_tpu/net").mkdir(parents=True, exist_ok=True)
+    (root / "script").mkdir(exist_ok=True)
+    (root / "garage_tpu/rpc/telemetry_digest.py").write_text(
+        DIGEST_SRC.format(version=version, s3_keys=s3_keys)
+    )
+    (root / "garage_tpu/net/connection.py").write_text(
+        FRAME_SRC.format(meta_keys=meta_keys)
+    )
+    (root / "garage_tpu/migr.py").write_text(
+        MIGR_SRC.format(marker=marker, previous=previous)
+    )
+    return str(root)
+
+
+def _wire_violations(root):
+    return [
+        v for v in analyze(root, ["garage_tpu"], ["wire-compat"])
+        if v.detail != "wire-schema:missing"
+    ]
+
+
+def test_wire_schema_drift(tmp_path):
+    """Acceptance: deleting a digest key or frame meta key without a
+    DIGEST_VERSION bump fails; adding keys is clean; bump + snapshot
+    regeneration is clean."""
+    from garage_tpu.analysis.core import Project
+    from garage_tpu.analysis.wire_compat import write_wire_schema
+
+    root = _write_wire_tree(tmp_path)
+
+    def snapshot():
+        p = Project(root)
+        p.add_tree("garage_tpu")
+        write_wire_schema(p)
+
+    snapshot()
+    assert _wire_violations(root) == []
+
+    # (a) digest key removed, version unchanged -> violation
+    _write_wire_tree(tmp_path, s3_keys='"req": 7')
+    vs = _wire_violations(root)
+    assert any(v.detail == "digest-key-removed:s3.rps" for v in vs)
+
+    # (b) key ADDED, version unchanged -> clean (additive evolution)
+    _write_wire_tree(tmp_path, s3_keys='"rps": 1.0, "req": 7, "p99": 0.1')
+    assert _wire_violations(root) == []
+
+    # (c) removal WITH a version bump -> only the regenerate reminder,
+    #     and after regenerating the snapshot the tree is clean
+    _write_wire_tree(tmp_path, version=2, s3_keys='"req": 7')
+    vs = _wire_violations(root)
+    assert [v.detail for v in vs] == ["wire-schema:version-drift"]
+    snapshot()
+    assert _wire_violations(root) == []
+
+    # (d) frame meta key removed without a bump -> violation
+    _write_wire_tree(tmp_path, version=2, s3_keys='"req": 7',
+                     meta_keys='"ep": "x"')
+    vs = _wire_violations(root)
+    assert any(v.detail == "frame-meta-removed:prio" for v in vs)
+
+    # (e) Migratable marker changed without PREVIOUS -> violation;
+    #     with PREVIOUS declared -> clean
+    _write_wire_tree(tmp_path, version=2, s3_keys='"req": 7',
+                     marker="T1thing")
+    vs = _wire_violations(root)
+    assert any(
+        v.detail == "migratable-marker-changed:Persisted" for v in vs
+    )
+    _write_wire_tree(tmp_path, version=2, s3_keys='"req": 7',
+                     marker="T1thing", previous="object")
+    assert _wire_violations(root) == []
+
+
+def test_wire_schema_committed_and_current():
+    """The committed snapshot must match the tree (a drifted snapshot
+    would make every future edit look like removal)."""
+    from garage_tpu.analysis.core import Project
+    from garage_tpu.analysis.wire_compat import build_schema
+
+    p = Project(REPO)
+    p.add_tree("garage_tpu")
+    want = build_schema(p)
+    got = json.load(open(os.path.join(REPO, "script", "wire_schema.json")))
+    assert got["digest_version"] == want["digest_version"]
+    assert got["digest_keys"] == want["digest_keys"]
+    assert got["frame_meta_keys"] == want["frame_meta_keys"]
+    assert got["migratable_markers"] == want["migratable_markers"]
+
+
 # --- 3. mechanics -------------------------------------------------------------
 
 
@@ -240,9 +441,13 @@ def test_analyzer_imports_stdlib_only():
 
     stdlib = set(_sys.stdlib_module_names)
     adir = os.path.join(REPO, "garage_tpu", "analysis")
-    for name in sorted(os.listdir(adir)):
-        if not name.endswith(".py"):
-            continue
+    present = {n for n in os.listdir(adir) if n.endswith(".py")}
+    # the guard must actually cover the ISSUE 10 rule files — a rename
+    # would silently drop them from this loop
+    assert {
+        "cancel_safety.py", "lock_await.py", "taint.py", "wire_compat.py",
+    } <= present
+    for name in sorted(present):
         tree = ast.parse(open(os.path.join(adir, name)).read())
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
@@ -271,14 +476,41 @@ def test_cli_exit_codes():
     )
     assert r.returncode == 1
     assert "orphan-task" in r.stdout
-    # JSON mode parses
+    # JSON mode parses, and carries per-rule timings
     r = subprocess.run(
         [sys.executable, script, "--no-baseline", "--json",
          f"{FIXTURES}/orphan_task.py"],
         capture_output=True, text=True, cwd=REPO,
     )
     assert r.returncode == 1
-    assert len(json.loads(r.stdout)["new"]) == 2
+    obj = json.loads(r.stdout)
+    assert len(obj["new"]) == 2
+    assert set(obj["timings"]) == {
+        "loop-blocker", "orphan-task", "swallowed-exception",
+        "resource-discipline", "cancel-safety", "lock-await",
+        "trust-boundary", "wire-compat",
+    }
+    assert all(t >= 0 for t in obj["timings"].values())
+
+
+def test_cli_diff_mode():
+    """--diff lints only files changed vs a git ref (the pre-commit
+    loop).  Against HEAD with a clean tree it reports nothing to do;
+    an unknown ref is a usage error, not a crash."""
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    r = subprocess.run(
+        [sys.executable, script, "--diff", "HEAD"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    # clean tree -> "no analyzable files changed" (0) or, with local
+    # edits in flight, a normal lint over just those files
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(
+        [sys.executable, script, "--diff", "no-such-ref-xyzzy"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 2
+    assert "git diff" in r.stderr
 
 
 def test_reap_propagates_caller_cancellation():

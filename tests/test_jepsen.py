@@ -330,6 +330,36 @@ def test_jepsen_combined_nemeses_duration(tmp_path):
     _run_jepsen(tmp_path, "3", run_seconds=60.0)
 
 
+def _dump_diagnostics(garages):
+    """On invariant failure, print what the next debugger needs instead
+    of a bare assert: every node's breaker table (the ~1/5 flake's
+    signature was breakers pinned open through the whole convergence
+    window) and the flight recorder's slow-request ring (ISSUE 10)."""
+    print("\n=== jepsen failure diagnostics ===", file=sys.stderr)
+    for i, g in enumerate(garages):
+        try:
+            ph = getattr(g, "peer_health", None)
+            snap = ph.snapshot() if ph is not None else {}
+            print(f"--- node {i} ({g.node_id.hex()[:8]}) breakers:",
+                  file=sys.stderr)
+            for peer, st in sorted(snap.items()):
+                print(f"    {peer[:8]} {st}", file=sys.stderr)
+            rec = getattr(g, "flight_recorder", None)
+            rows = rec.snapshot()[:3] if rec is not None else []
+            print(f"--- node {i} slow-ring top {len(rows)}:",
+                  file=sys.stderr)
+            for r in rows:
+                print(
+                    f"    {r.get('name')} {r.get('durationMs')}ms "
+                    f"trace={r.get('traceId')}",
+                    file=sys.stderr,
+                )
+        except Exception as e:  # noqa: BLE001 — diagnostics must not mask
+            print(f"--- node {i}: diagnostics failed: {e!r}",
+                  file=sys.stderr)
+    print("=== end diagnostics ===", file=sys.stderr)
+
+
 def _run_jepsen(tmp_path, mode, run_seconds=RUN_SECONDS):
     async def main():
         garages, servers, clients, key = await boot_cluster(tmp_path, mode=mode)
@@ -400,6 +430,9 @@ def _run_jepsen(tmp_path, mode, run_seconds=RUN_SECONDS):
                 assert got >= last, f"{k}: acked v{last} lost (read v{got})"
 
             await check_set2(hist, clients[1])
+        except AssertionError:
+            _dump_diagnostics(garages)
+            raise
         finally:
             set_clock_offset(0)
             for c in clients:

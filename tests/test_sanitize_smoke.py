@@ -1,7 +1,8 @@
-"""Sanitizer smoke (ISSUE 7 satellite): build the native module under
-ASan / UBSan and run the kvlog group-commit protocol once through the
-real ctypes binding — memory errors and UB in the flusher/committer
-paths fail the run.  Slow-marked: each mode pays a full g++ rebuild."""
+"""Sanitizer smoke (ISSUE 7 satellite; --all summary from ISSUE 10):
+build the native module under ASan / UBSan and run the kvlog
+group-commit protocol once through the real ctypes binding — memory
+errors and UB in the flusher/committer paths fail the run.
+Slow-marked: each mode pays a full g++ rebuild."""
 
 import os
 import shutil
@@ -25,3 +26,21 @@ def test_sanitized_kvlog_group_commit_smoke(mode):
         f"{mode} smoke failed (rc {r.returncode}):\n{r.stdout}\n{r.stderr}"
     )
     assert "group-commit smoke clean" in r.stdout
+
+
+@pytest.mark.slow
+def test_sanitize_all_summary():
+    """--all chains tsan+asan+ubsan and prints one summary table with a
+    PASS/FAIL row per mode."""
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    r = subprocess.run(
+        [SCRIPT, "--all"], cwd=REPO, capture_output=True, text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, (
+        f"--all failed (rc {r.returncode}):\n{r.stdout}\n{r.stderr}"
+    )
+    assert "sanitize-native summary" in r.stdout
+    for mode in ("tsan", "asan", "ubsan"):
+        assert f"{mode}\tPASS" in r.stdout, r.stdout
