@@ -142,7 +142,11 @@ def phased_probe(env, transcript=None):
     wedge/failure, runs shorter single-phase children to bracket where the
     backend stalls, then writes `tpu_runs/probe_profile_<ts>.json` — the
     committed per-phase wedge profile VERDICT r4 asked for — and returns
-    None.
+    None.  The profile carries a structured `failure_reason` ({phase, rc,
+    timed_out, dt, stderr_tail}) taken from the bracket child that
+    targeted the wedged phase (ISSUE 11): `BENCH_r05.json`'s probe has
+    wedged at `devices` for six rounds with zero evidence of WHY, because
+    the killed child's stderr died with its pipe.
     """
     me = os.path.abspath(__file__)
 
@@ -153,8 +157,12 @@ def phased_probe(env, transcript=None):
             transcript.record(f"probe-{phase}", cmd, rc, out, err, dt)
         stamps = [l for l in json_lines(out) if "phase" in l]
         final = [l for l in json_lines(out) if "probe" in l]
+        # the child's stderr tail rides the artifact: five rounds of
+        # "wedged at devices" taught nothing because the PJRT/plugin
+        # noise that says WHY died with the killed child's pipe
         return {"phase_arg": phase, "rc": rc, "dt": round(dt, 1),
-                "stamps": stamps, "final": final[-1] if final else None}
+                "stamps": stamps, "final": final[-1] if final else None,
+                "stderr_tail": (err or "")[-2000:]}
 
     full = run_phase("dispatch", PROBE_TIMEOUT)
     if full["rc"] == 0 and full["final"] and full["final"].get("probe") == "ok":
@@ -170,9 +178,23 @@ def phased_probe(env, transcript=None):
     fast_error = (
         full["rc"] not in (0, "TIMEOUT") and full["dt"] < PROBE_TIMEOUT / 2
     )
+
+    def reason_from(attempt, phase):
+        """Structured failure evidence from one probe child: what the
+        next (human or agent) TPU session needs to DIAGNOSE the stuck
+        phase instead of re-running the whole ladder blind."""
+        return {
+            "phase": phase,
+            "rc": attempt["rc"],
+            "timed_out": attempt["rc"] == "TIMEOUT",
+            "dt": attempt["dt"],
+            "stderr_tail": attempt.get("stderr_tail", ""),
+        }
+
     if fast_error:
         profile["result"] = "failed"
         profile["wedged_at"] = None
+        profile["failure_reason"] = reason_from(full, "full")
     else:
         profile["result"] = "wedged"
         profile["brackets"] = [run_phase("import", 45), run_phase("devices", 45)]
@@ -180,6 +202,17 @@ def phased_probe(env, transcript=None):
         order = ["import", "devices", "dispatch"]
         profile["wedged_at"] = next(
             (p for p in order if p not in reached), "after-dispatch"
+        )
+        # prefer the single-phase bracket child that targeted the wedged
+        # phase (its stderr is the devices-phase PJRT/tunnel evidence the
+        # BENCH_r05 probe never surfaced); fall back to the full run
+        culprit = next(
+            (b for b in profile["brackets"]
+             if b["phase_arg"] == profile["wedged_at"]),
+            full,
+        )
+        profile["failure_reason"] = reason_from(
+            culprit, profile["wedged_at"]
         )
     d = os.path.join(REPO, "tpu_runs")
     os.makedirs(d, exist_ok=True)

@@ -28,10 +28,32 @@ Distributed-correctness families (ISSUE 10):
                        vs DIGEST_VERSION); CRDT classes may only mutate
                        state in __init__/merge*/update*
 
+Accelerator-dispatch families (ISSUE 11, gating the TPU codec surface
+ahead of the pjit/AOT migration):
+
+  host-sync            device->host sync points (np.asarray on a jit
+                       result, block_until_ready, scalar extraction)
+                       reachable from coroutines — the loop-blocker
+                       rule for the device boundary
+  recompile-hazard     compiled dispatches whose batch never flowed
+                       through an ops/bucketing.py pad helper, and
+                       Python control flow on traced values in jitted
+                       defs — the fixed-shape discipline
+  use-after-donation   a donate_argnums buffer read after XLA deleted
+                       it (CPU tests never see the crash), plus an
+                       advisory for undonated dispatch-sized calls
+  backend-gate         backend-string comparisons outside the declared
+                       telemetry module, and /codec/ dispatches that
+                       don't count block_codec_*{path} — the PR 4
+                       silent-CPU-fallback class
+
 Resolution: name-based plus receiver types learned from constructor
 assignments (``self.x = Foo()``) and parameter annotations — calls like
 ``self.persister.save(...)`` resolve one level deep (no general type
-inference).
+inference).  The accelerator families share `device_model.py`: jit
+factories resolved through two return hops (donation positions
+included), pad-to-bucket provenance followed through wrapper calls,
+and traced-def discovery through jit/shard_map/pallas_call arguments.
 
 Run via ``script/graft_lint.py`` (tier-1 gated by
 ``tests/test_graft_lint.py`` against ``script/lint_baseline.json``;

@@ -45,6 +45,7 @@ import ast
 import re
 
 from .core import Project, Violation, call_repr
+from .core import walk_no_defs as _walk_no_defs
 
 RULE = "cancel-safety"
 
@@ -62,14 +63,7 @@ def _last(repr_: str) -> str:
     return repr_.rsplit(".", 1)[-1]
 
 
-def _walk_no_defs(node):
-    """All descendants, excluding nested function/lambda bodies (their
-    awaits/cancels belong to the nested function's own analysis)."""
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        yield child
-        yield from _walk_no_defs(child)
+# nested-def walks use the shared core.walk_no_defs (imported above)
 
 
 def _body_walk(fn_node):

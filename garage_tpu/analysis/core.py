@@ -35,6 +35,10 @@ PRAGMA_KINDS = {
     "lock-await",  # lock-across-await (slow await under a mutex)
     "taint",  # trust-boundary (pre-auth/peer data reaching a sink)
     "wire",  # wire-compat (CRDT mutation discipline)
+    "host-sync",  # host-sync (device->host sync point on the loop)
+    "recompile",  # recompile-hazard (unbucketed dispatch / traced branch)
+    "donation",  # use-after-donation (donated buffer re-read / advisory)
+    "backend-gate",  # backend-conditional (platform compare / uncounted path)
 }
 
 
@@ -479,6 +483,46 @@ def _collect_imports(
     return out
 
 
+def walk_no_defs(node):
+    """All descendants of `node`, excluding nested function/lambda
+    bodies (defining an inner function does not execute it; a nested
+    def's hazards belong to its own analysis).  THE shared skip-defs
+    walker — rules must use this instead of growing private copies, so
+    a change to the skip set lands everywhere at once."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield child
+        yield from walk_no_defs(child)
+
+
+def iter_async_reachable(project: "Project", fn: FunctionInfo, max_depth: int):
+    """BFS from coroutine `fn` through name-resolved SYNC helpers:
+    yields (func, chain, depth) for `fn` itself and every sync callee
+    within `max_depth` hops.  Awaited coroutines are skipped (they get
+    their own pass as BFS roots); functions only ever *passed* (e.g. to
+    ``asyncio.to_thread``) never appear — they are not in the call
+    graph.  THE shared reachability walk for the loop-blocker-shaped
+    rules (loop-blocker, host-sync): a fix to hop resolution must land
+    in both at once."""
+    queue = [(fn, [fn.qualname], 0)]
+    visited = {(fn.module, fn.qualname)}
+    while queue:
+        cur, chain, depth = queue.pop(0)
+        yield cur, chain, depth
+        if depth >= max_depth:
+            continue
+        for callee, _line in cur.calls:
+            target = project.resolve_call(cur, callee)
+            if target is None or target.is_async:
+                continue
+            key = (target.module, target.qualname)
+            if key in visited:
+                continue
+            visited.add(key)
+            queue.append((target, chain + [target.qualname], depth + 1))
+
+
 def iter_nodes_with_owner(sf: SourceFile):
     """Yield (node, owner_qualname) for every AST node in the file,
     where owner is the NEAREST enclosing function ('<module>' outside
@@ -517,10 +561,14 @@ def analyze(
     import time
 
     from . import (
+        backend_gate,
         cancel_safety,
+        donation,
+        host_sync,
         lock_await,
         loop_blocker,
         orphan_task,
+        recompile,
         resource,
         swallowed,
         taint,
@@ -540,6 +588,10 @@ def analyze(
         "lock-await": lock_await.check,
         "trust-boundary": taint.check,
         "wire-compat": wire_compat.check,
+        "host-sync": host_sync.check,
+        "recompile-hazard": recompile.check,
+        "use-after-donation": donation.check,
+        "backend-gate": backend_gate.check,
     }
     selected = set(rules) if rules else set(all_rules)
     unknown = selected - set(all_rules)

@@ -1,0 +1,379 @@
+"""Shared device-dispatch model for the accelerator rule families.
+
+The four ISSUE 11 families (host-sync, recompile-hazard,
+use-after-donation, backend-gate) all need the same three facts about a
+function, none of which the name-resolved call graph in `core.py`
+carries by itself:
+
+  1. **Which local names hold a compiled device callable** — the repo's
+     dispatch idiom is a memoized factory (``@instrumented_cache`` on
+     ``ec_apply_fn`` / ``ec_encode_hash_fn`` / ``_hasher_for_len``)
+     whose body returns ``jax.jit(body, ...)``; call sites do
+     ``fn = ec_apply_fn(...); fn(bitmat, x)``.  `compiled_locals`
+     resolves the factory through up to two return hops and records the
+     donated argument positions declared on the `jit` call (literal
+     ``donate_argnums=`` or a ``**_donate_kwargs(...)`` star whose
+     callee returns a dict literal carrying the key).
+
+  2. **Which values carry pad-to-bucket provenance** — the fixed-shape
+     discipline pads the batch axis through a recognized helper
+     (``bucket_batch`` / ``pad_to_bucket`` / ``pad_to_multiple``,
+     matched on the last name segment with leading underscores
+     stripped) so one compiled executable serves every ragged batch.
+     `carries_pad` follows the value through wrapper calls
+     (``jax.device_put(jnp.asarray(x_padded), ...)`` stays padded) and
+     simple assignments.
+
+  3. **Which defs are traced** — functions handed to
+     ``jit``/``pjit``/``shard_map``/``pallas_call`` either directly by
+     name, as a local bound from a body-factory call
+     (``body = _ec_body(...); jax.jit(body)``), or as the returned
+     inner def of a factory whose *call* is the `jit` argument
+     (``jax.jit(self.encode_and_hash_fn())``).  Python control flow on
+     their parameters re-traces per value (or raises
+     ``TracerBoolConversionError``) — the recompile family's second
+     sub-rule.
+
+Everything here is approximate by design (no type inference): the model
+errs toward silence — a value it cannot prove device-resident or a
+callable it cannot resolve is simply not reported on, matching the
+resolution limits documented in doc/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FunctionInfo, Project, call_repr, walk_no_defs
+
+__all__ = [
+    "PLATFORM_STRINGS", "SHAPE_ATTRS", "PAD_LASTS", "walk_no_defs",
+    "compiled_locals", "factory_donation", "jit_call_donated",
+    "carries_pad", "padded_names", "device_names", "is_devish",
+    "traced_defs", "last_segment",
+]
+
+# platform strings a backend-conditional compares against
+PLATFORM_STRINGS = {"cpu", "tpu", "gpu", "cuda", "rocm", "metal"}
+
+# attribute reads that are static at trace time (shapes are not tracers)
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "nbytes", "itemsize"}
+
+# recognized pad-to-bucket helpers, matched on the final name segment
+# with leading underscores stripped ("_pad_batch" == "pad_batch")
+PAD_LASTS = {
+    "pad_batch", "pad_to_bucket", "pad_to_multiple", "pad_for_mesh",
+    "bucket_batch",
+}
+
+JIT_LASTS = {"jit", "pjit"}
+TRACE_WRAPPER_LASTS = {"jit", "pjit", "shard_map", "pallas_call"}
+
+MAX_FACTORY_HOPS = 2
+
+
+def last_segment(repr_: str) -> str:
+    return repr_.rsplit(".", 1)[-1].lstrip("_")
+
+
+def _is_pad_call(call: ast.Call) -> bool:
+    r = call_repr(call.func)
+    return r is not None and last_segment(r) in PAD_LASTS
+
+
+# --- donation extraction ------------------------------------------------------
+
+
+def _positions_from_literal(node) -> tuple[int, ...] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return tuple(out)
+    return None
+
+
+def _donate_from_dict_literal(fn: FunctionInfo) -> tuple[int, ...] | None:
+    """Scan a helper like ``_donate_kwargs`` for any dict literal that
+    carries a ``donate_argnums`` key (the backend-conditional
+    ``{} if cpu else {"donate_argnums": (1,)}`` form included)."""
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            if (
+                isinstance(k, ast.Constant)
+                and k.value == "donate_argnums"
+            ):
+                pos = _positions_from_literal(v)
+                if pos:
+                    return pos
+    return None
+
+
+def jit_call_donated(
+    project: Project, caller: FunctionInfo, call: ast.Call
+) -> tuple[int, ...]:
+    """Donated argument positions declared on a jit/pjit call: a literal
+    ``donate_argnums=`` keyword, or a ``**helper(...)`` star whose
+    callee's body returns a dict literal with the key."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            pos = _positions_from_literal(kw.value)
+            if pos:
+                return pos
+        elif kw.arg is None and isinstance(kw.value, ast.Call):
+            r = call_repr(kw.value.func)
+            if r is None:
+                continue
+            target = project.resolve_call(caller, r)
+            if target is not None:
+                pos = _donate_from_dict_literal(target)
+                if pos:
+                    return pos
+    return ()
+
+
+def factory_donation(
+    project: Project, fn: FunctionInfo, _depth: int = 0
+) -> tuple[bool, tuple[int, ...]]:
+    """(is_compiled_factory, donated_positions): does `fn` return a
+    jit-compiled callable — directly (``return jax.jit(body, ...)``,
+    tuple returns included) or through one more factory hop
+    (``return _build(n)`` where ``_build`` returns a jit)?"""
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        for sub in ast.walk(node.value):
+            if not isinstance(sub, ast.Call):
+                continue
+            r = call_repr(sub.func)
+            if r is None:
+                continue
+            if r.rsplit(".", 1)[-1] in JIT_LASTS:
+                return True, jit_call_donated(project, fn, sub)
+            if _depth < MAX_FACTORY_HOPS:
+                target = project.resolve_call(fn, r)
+                if target is not None and target is not fn:
+                    ok, donated = factory_donation(
+                        project, target, _depth + 1
+                    )
+                    if ok:
+                        return True, donated
+    return False, ()
+
+
+def compiled_locals(
+    project: Project, fn: FunctionInfo
+) -> dict[str, tuple[int, ...]]:
+    """Local names bound to a compiled device callable inside `fn`:
+    ``f = <factory>(...)`` where the factory resolves to a function
+    returning a jit (donated positions attached), or a direct
+    ``f = jax.jit(...)``.  Tuple targets map every name (the extra
+    names — e.g. the mesh of ``fn, mesh = ec_apply_fn_mesh(...)`` —
+    are never called, so over-marking is harmless)."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in walk_no_defs(fn.node):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        call = node.value
+        r = call_repr(call.func)
+        if r is None:
+            continue
+        donated: tuple[int, ...] | None = None
+        if r.rsplit(".", 1)[-1] in JIT_LASTS:
+            donated = jit_call_donated(project, fn, call)
+        else:
+            target = project.resolve_call(fn, r)
+            if target is not None:
+                ok, d = factory_donation(project, target)
+                if ok:
+                    donated = d
+        if donated is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = donated
+            elif isinstance(tgt, ast.Tuple):
+                for e in tgt.elts:
+                    if isinstance(e, ast.Name):
+                        out[e.id] = donated
+    return out
+
+
+# --- pad provenance -----------------------------------------------------------
+
+
+def carries_pad(expr, padded: set[str]) -> bool:
+    """Does evaluating `expr` yield a value with pad-to-bucket
+    provenance?  Pad-helper calls are sources; other calls PRESERVE
+    provenance from their arguments (``device_put(jnp.asarray(xp))``
+    is still the padded batch); names propagate via `padded_names`."""
+    if isinstance(expr, ast.Call):
+        if _is_pad_call(expr):
+            return True
+        return any(
+            carries_pad(a, padded)
+            for a in list(expr.args) + [kw.value for kw in expr.keywords]
+        )
+    if isinstance(expr, ast.Name):
+        return expr.id in padded
+    for child in ast.iter_child_nodes(expr):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if carries_pad(child, padded):
+            return True
+    return False
+
+
+def padded_names(fn_node) -> set[str]:
+    """Names assigned (directly or through wrapper calls / simple
+    chains) from a pad-to-bucket helper inside one function."""
+    padded: set[str] = set()
+    for _ in range(2):  # fixed-point over simple assignment chains
+        for node in walk_no_defs(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not carries_pad(node.value, padded):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    padded.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    padded.update(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+    return padded
+
+
+# --- device-value tracking (host-sync) ----------------------------------------
+
+_DEVICE_CALL_PREFIXES = ("jnp.", "jax.numpy.")
+_DEVICE_CALL_REPRS = {"jax.device_put"}
+
+
+def _is_device_call(call: ast.Call, compiled: dict[str, tuple]) -> bool:
+    r = call_repr(call.func)
+    if r is None:
+        return False
+    if r in compiled or r.startswith(_DEVICE_CALL_PREFIXES):
+        return True
+    return r in _DEVICE_CALL_REPRS
+
+
+def device_names(fn_node, compiled: dict[str, tuple]) -> set[str]:
+    """Local names holding (likely) device-resident arrays: assigned —
+    tuple unpacks included — from a call to a compiled local callable,
+    ``jnp.*``, or ``jax.device_put``."""
+    dev: set[str] = set()
+    for _ in range(2):
+        for node in walk_no_defs(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            hit = (isinstance(v, ast.Call) and _is_device_call(v, compiled)) or (
+                isinstance(v, ast.Name) and v.id in dev
+            )
+            if not hit:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    dev.add(t.id)
+                elif isinstance(t, ast.Tuple):
+                    dev.update(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+    return dev
+
+
+def is_devish(expr, dev: set[str], compiled: dict[str, tuple]) -> bool:
+    """Is `expr` (an argument/receiver) a device value: a tracked name,
+    a direct call to a compiled callable / jnp constructor, or an
+    expression containing one (``fn(x)[0]``, ``parity[:b]``)?"""
+    if isinstance(expr, ast.Name):
+        return expr.id in dev
+    if isinstance(expr, ast.Call) and _is_device_call(expr, compiled):
+        return True
+    if isinstance(expr, ast.Attribute) and expr.attr in SHAPE_ATTRS:
+        return False  # x.shape[0] etc. are host ints, not device values
+    for child in ast.iter_child_nodes(expr):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if is_devish(child, dev, compiled):
+            return True
+    return False
+
+
+# --- traced defs (recompile sub-rule 2) ---------------------------------------
+
+
+def traced_defs(project: Project) -> set[tuple[str, str]]:
+    """(module, qualname) of every def the project hands to a trace
+    wrapper (jit/pjit/shard_map/pallas_call): by name, through a local
+    bound from a body-factory call, or as the returned inner def of a
+    factory whose call is the wrapper argument."""
+    out: set[tuple[str, str]] = set()
+
+    def mark_by_last(mod: str, name: str) -> None:
+        for fn in project._by_name.get(mod, {}).get(name, []):
+            out.add((fn.module, fn.qualname))
+
+    def mark_returned_defs(target: FunctionInfo) -> None:
+        """Names returned by `target` that are its own nested defs."""
+        inner = {
+            q.rsplit(".", 1)[-1]
+            for (m, q) in project.functions
+            if m == target.module and q.startswith(target.qualname + ".")
+        }
+        for node in ast.walk(target.node):
+            if (
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in inner
+            ):
+                out.add(
+                    (target.module, f"{target.qualname}.{node.value.id}")
+                )
+
+    for fn in project.functions.values():
+        # local name -> factory the trace argument may have come from
+        local_factories: dict[str, FunctionInfo] = {}
+        for node in walk_no_defs(fn.node):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                r = call_repr(node.value.func)
+                target = (
+                    project.resolve_call(fn, r) if r is not None else None
+                )
+                if target is not None:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_factories[t.id] = target
+        for node in walk_no_defs(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            r = call_repr(node.func)
+            if r is None or r.rsplit(".", 1)[-1] not in TRACE_WRAPPER_LASTS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    if arg.id in local_factories:
+                        mark_returned_defs(local_factories[arg.id])
+                    mark_by_last(fn.module, arg.id)
+                elif isinstance(arg, ast.Call):
+                    ar = call_repr(arg.func)
+                    target = (
+                        project.resolve_call(fn, ar)
+                        if ar is not None
+                        else None
+                    )
+                    if target is not None:
+                        mark_returned_defs(target)
+    return out

@@ -1,0 +1,229 @@
+"""use-after-donation: reading a buffer after XLA took ownership of it.
+
+``donate_argnums`` hands the input buffer to XLA for reuse as the
+output (the SNIPPETS pjit exemplar pattern — it removes a full HBM copy
+per dispatch).  After the call, the donated array is DELETED: touching
+it raises ``RuntimeError: Array has been deleted`` — but only at
+runtime, only on backends that honor donation (CPU ignores it with a
+warning), and only on the code path that actually re-reads.  That is
+the worst kind of crash class for a repo whose tests run on the CPU
+fallback: tier-1 stays green while the TPU path crashes.
+
+Sub-rules:
+
+- **use-after-donation** — a NAME passed at a donated position of a
+  compiled callable (factory-resolved, see device_model) and read again
+  after the call.  Branch-aware: a read on a mutually exclusive ``If``
+  arm, or after an ``If`` whose dispatch arm returns/raises, cannot
+  follow the donation and is not flagged.
+
+- **donated-reuse-in-loop** — the same call inside a ``for``/``while``
+  loop where the donated name is never rebound inside the loop:
+  iteration 2 re-reads the buffer iteration 1 donated.  Any rebind
+  inside the loop is clean — before the dispatch (fresh buffer this
+  iteration, the retry idiom) or after it (fresh buffer for the next,
+  the producer/consumer idiom).
+
+- **undonated-dispatch** (advisory) — a dispatch-sized call site (an
+  argument carries pad-to-bucket provenance, so this is the coalesced
+  foreground/repair batch path) into a compiled callable whose factory
+  declares NO donation: the dispatch pays an avoidable HBM copy per
+  batch.  Advisory because donation is sometimes wrong by design
+  (long-lived bench arrays, retry paths that re-drive the same host
+  batch) — say so in the pragma.
+
+Suppression: ``# graft-lint: allow-donation(<reason>)`` on the call
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Project, Violation
+from .device_model import carries_pad, compiled_locals, padded_names, walk_no_defs
+
+RULE = "use-after-donation"
+
+
+def _ctx_walk(fn_node):
+    """Yield (node, innermost_enclosing_loop_or_None, branch_path) with
+    nested defs skipped.  branch_path is a tuple of (if_node, arm)
+    pairs — arm 0 = body, 1 = orelse — for every enclosing If, so the
+    rule can tell mutually exclusive branches apart (a read on the
+    `else` arm of the dispatch's `if` can never follow the donation)."""
+    out: list[tuple] = []
+
+    def visit(node, loop, path):
+        out.append((node, loop, path))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # don't descend: defining an inner fn runs nothing
+        nloop = node if isinstance(node, (ast.For, ast.While)) else loop
+        if isinstance(node, ast.If):
+            visit(node.test, nloop, path)
+            for arm, stmts in ((0, node.body), (1, node.orelse)):
+                for stmt in stmts:
+                    visit(stmt, nloop, path + ((node, arm),))
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, nloop, path)
+
+    for stmt in fn_node.body:
+        visit(stmt, None, ())
+    return out
+
+
+def _arm_terminates(if_node, arm: int) -> bool:
+    """Does the If arm end in Return/Raise/Continue/Break — i.e. can
+    control NEVER fall through to the statements after the If?"""
+    stmts = if_node.body if arm == 0 else if_node.orelse
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+def _read_reachable_after(call_path, read_path, read_line, call_end) -> bool:
+    """Control-flow filter for read-after-donation: a read on a
+    MUTUALLY EXCLUSIVE If arm, or after an If whose dispatch arm
+    terminates, cannot execute after the donation."""
+    call_ifs = {id(n): (n, arm) for n, arm in call_path}
+    for n, arm in read_path:
+        hit = call_ifs.get(id(n))
+        if hit is not None and hit[1] != arm:
+            return False  # sibling arms of the same If: exclusive
+    # the dispatch arm returns/raises: code after that If never runs
+    # post-donation
+    for n, arm in call_path:
+        if id(n) not in {id(m) for m, _ in read_path}:
+            if _arm_terminates(n, arm) and read_line > (
+                getattr(n, "end_lineno", n.lineno)
+            ):
+                return False
+    return read_line > call_end
+
+
+def _name_reads_after(ctx, name: str, call_end: int, call_path) -> int | None:
+    """Line of the first Load of `name` that can actually execute after
+    the donating call (branch-exclusive reads filtered out)."""
+    hits = [
+        n.lineno
+        for n, _loop, path in ctx
+        if isinstance(n, ast.Name)
+        and n.id == name
+        and isinstance(n.ctx, ast.Load)
+        and _read_reachable_after(call_path, path, n.lineno, call_end)
+    ]
+    return min(hits) if hits else None
+
+
+def _binds_name(target, name: str) -> bool:
+    if isinstance(target, ast.Name):
+        return target.id == name
+    if isinstance(target, (ast.Tuple, ast.List)):
+        return any(_binds_name(e, name) for e in target.elts)
+    if isinstance(target, ast.Starred):
+        return _binds_name(target.value, name)
+    return False
+
+
+def _bound_inside(loop, name: str) -> bool:
+    """Is `name` (re)bound ANYWHERE inside `loop` — a plain/aug/walrus
+    assignment, the loop's OWN for-target (fresh binding every
+    iteration, the canonical per-item dispatch loop), or a
+    ``with … as`` item?  Before the dispatch means a fresh buffer this
+    iteration; after it means a fresh buffer for the NEXT iteration
+    (producer/consumer loops) — either way no iteration re-dispatches a
+    buffer a previous one donated."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            if any(_binds_name(t, name) for t in node.targets):
+                return True
+        elif isinstance(node, (ast.AugAssign, ast.NamedExpr)):
+            if _binds_name(node.target, name):
+                return True
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if _binds_name(node.target, name):
+                return True
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if any(
+                item.optional_vars is not None
+                and _binds_name(item.optional_vars, name)
+                for item in node.items
+            ):
+                return True
+    return False
+
+
+def check(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for fn in project.functions.values():
+        compiled = compiled_locals(project, fn)
+        if not compiled:
+            continue
+        sf = project.files[fn.module]
+        padded = padded_names(fn.node)
+        ctx = _ctx_walk(fn.node)
+        for node, loop, call_path in ctx:
+            if not isinstance(node, ast.Call):
+                continue
+            if not (
+                isinstance(node.func, ast.Name) and node.func.id in compiled
+            ):
+                continue
+            donated = compiled[node.func.id]
+            if not donated:
+                # advisory: a dispatch-sized (bucketed) batch with no
+                # buffer donation pays an avoidable HBM copy
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                if args and any(carries_pad(a, padded) for a in args):
+                    if not sf.pragma_for(node, "donation"):
+                        out.append(
+                            Violation(
+                                RULE, fn.module, node.lineno, fn.qualname,
+                                f"undonated-dispatch:{node.func.id}",
+                                f"dispatch-sized call {node.func.id}() "
+                                "(bucketed batch) into a jit with no "
+                                "donate_argnums — the consume-once input "
+                                "costs a full HBM copy per dispatch "
+                                "(advisory); donate it, or state why not "
+                                "with # graft-lint: allow-donation"
+                                "(<reason>)",
+                            )
+                        )
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            for pos in donated:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if not isinstance(arg, ast.Name):
+                    continue
+                if sf.pragma_for(node, "donation"):
+                    continue
+                read_at = _name_reads_after(ctx, arg.id, end, call_path)
+                if read_at is not None:
+                    out.append(
+                        Violation(
+                            RULE, fn.module, node.lineno, fn.qualname,
+                            f"use-after-donation:{node.func.id}:{arg.id}",
+                            f"{arg.id!r} is donated to "
+                            f"{node.func.id}() (donate_argnums position "
+                            f"{pos}) but read again on line {read_at} — "
+                            "XLA deleted that buffer; 'Array has been "
+                            "deleted' at runtime on device backends",
+                        )
+                    )
+                elif loop is not None and not _bound_inside(loop, arg.id):
+                    out.append(
+                        Violation(
+                            RULE, fn.module, node.lineno, fn.qualname,
+                            f"donated-reuse-in-loop:{node.func.id}:{arg.id}",
+                            f"{arg.id!r} is donated to "
+                            f"{node.func.id}() inside a loop but bound "
+                            "outside it — iteration 2 re-reads the "
+                            "buffer iteration 1 donated; rebind it "
+                            "fresh inside the loop (retry idiom)",
+                        )
+                    )
+    out.sort(key=lambda v: (v.path, v.line, v.detail))
+    return out

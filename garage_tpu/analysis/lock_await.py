@@ -37,6 +37,7 @@ import ast
 import re
 
 from .core import Project, Violation, call_repr
+from .core import walk_no_defs as _walk_no_defs
 
 RULE = "lock-await"
 
@@ -64,12 +65,8 @@ def _last(repr_: str) -> str:
     return repr_.rsplit(".", 1)[-1]
 
 
-def _walk_no_defs(node):
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        yield child
-        yield from _walk_no_defs(child)
+# the shared skip-defs walker (core.walk_no_defs): a nested def's
+# awaits belong to its own analysis
 
 
 def _lock_name(ctx) -> str | None:

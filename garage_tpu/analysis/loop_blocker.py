@@ -20,7 +20,7 @@ call, where the next reader needs the justification.
 
 from __future__ import annotations
 
-from .core import Project, Violation
+from .core import Project, Violation, iter_async_reachable
 
 MAX_DEPTH = 2  # sync hops between the coroutine and the blocking call
 
@@ -67,46 +67,35 @@ def check(project: Project) -> list[Violation]:
     for (mod, _qual), fn in project.functions.items():
         if not fn.is_async:
             continue
-        # BFS from the coroutine through sync helpers
-        # queue entries: (function, chain-of-names, depth)
-        queue = [(fn, [fn.qualname], 0)]
-        visited = {(fn.module, fn.qualname)}
-        while queue:
-            cur, chain, depth = queue.pop(0)
+        # the shared loop-blocker-shaped reachability walk (core):
+        # blocking callees are reported at every visited hop, sync
+        # helpers are followed up to MAX_DEPTH, awaited coroutines get
+        # their own pass as BFS roots
+        for cur, chain, depth in iter_async_reachable(project, fn, MAX_DEPTH):
             sf = project.files[cur.module]
             for callee, line in cur.calls:
-                if _is_blocking(callee):
-                    node = _call_node_at(sf, cur, callee, line)
-                    if node is not None and sf.pragma_for(node, "blocking"):
-                        continue
-                    via = "" if depth == 0 else " via " + " -> ".join(chain[1:])
-                    detail = callee + ("|" + ">".join(chain[1:]) if depth else "")
-                    dedup = (cur.module, fn.qualname, line, callee)
-                    if dedup in reported:
-                        continue
-                    reported.add(dedup)
-                    out.append(
-                        Violation(
-                            "loop-blocker", cur.module, line, fn.qualname,
-                            detail,
-                            f"blocking call {callee}() reachable from "
-                            f"coroutine {fn.qualname}{via} — stalls the "
-                            "event loop; offload with asyncio.to_thread "
-                            "or suppress with "
-                            "# graft-lint: allow-blocking(<reason>)",
-                        )
+                if not _is_blocking(callee):
+                    continue
+                node = _call_node_at(sf, cur, callee, line)
+                if node is not None and sf.pragma_for(node, "blocking"):
+                    continue
+                via = "" if depth == 0 else " via " + " -> ".join(chain[1:])
+                detail = callee + ("|" + ">".join(chain[1:]) if depth else "")
+                dedup = (cur.module, fn.qualname, line, callee)
+                if dedup in reported:
+                    continue
+                reported.add(dedup)
+                out.append(
+                    Violation(
+                        "loop-blocker", cur.module, line, fn.qualname,
+                        detail,
+                        f"blocking call {callee}() reachable from "
+                        f"coroutine {fn.qualname}{via} — stalls the "
+                        "event loop; offload with asyncio.to_thread "
+                        "or suppress with "
+                        "# graft-lint: allow-blocking(<reason>)",
                     )
-                    continue
-                if depth >= MAX_DEPTH:
-                    continue
-                target = project.resolve_call(cur, callee)
-                if target is None or target.is_async:
-                    continue  # awaited coroutines get their own pass
-                key = (target.module, target.qualname)
-                if key in visited:
-                    continue
-                visited.add(key)
-                queue.append((target, chain + [target.qualname], depth + 1))
+                )
     return out
 
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 import ast
 
 from .core import FunctionInfo, Project, Violation, call_repr
+from .core import walk_no_defs as _walk_no_defs
 
 RULE = "trust-boundary"
 
@@ -62,12 +63,7 @@ def _last(repr_: str) -> str:
     return repr_.rsplit(".", 1)[-1]
 
 
-def _walk_no_defs(node):
-    for child in ast.iter_child_nodes(node):
-        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        yield child
-        yield from _walk_no_defs(child)
+# nested-def walks use the shared core.walk_no_defs (imported above)
 
 
 def _is_source(node) -> str | None:

@@ -82,7 +82,21 @@ class EcCodec(BlockCodec):
     def decode(self, pieces: dict[int, bytes], block_len: int) -> bytes:
         data_idx = [i for i in range(self.k) if i in pieces]
         if len(data_idx) == self.k:
+            # systematic fast path: the k data shards ARE the plaintext —
+            # counted under its own path label so the GET pipeline's
+            # systematic share (systematic / (systematic+reconstruct)
+            # within op="decode") is computable (ROADMAP item 1a feeds
+            # on exactly this number)
+            _count(
+                "decode", "systematic", 1, self.k * self.piece_len(block_len)
+            )
             return b"".join(pieces[i] for i in range(self.k))[:block_len]
+        # degraded GET: some data shard is missing, a real decode runs.
+        # Counted as op="decode" (the GET-path view) IN ADDITION to the
+        # op="reconstruct" count inside reconstruct_pieces — that label
+        # is shared with the background repair plane, so without this
+        # one the GET decode share would be unrecoverable
+        _count("decode", "reconstruct", 1, self.k * self.piece_len(block_len))
         missing = [i for i in range(self.k) if i not in pieces]
         rec = self.reconstruct_pieces(pieces, missing, block_len)
         full = {**pieces, **rec}
@@ -137,9 +151,12 @@ class EcCodec(BlockCodec):
         backend there (measured: 54 ms vs 0.5 ms per 1 MiB block)."""
         if self._tpu is None:
             return False
-        from ...ops.telemetry import resolved_platform
+        from ...ops.telemetry import is_host_platform, resolved_platform
 
-        return resolved_platform(self._tpu.platform) not in ("cpu", "unknown")
+        # the ONE shared definition of "host backend" (lint rule
+        # backend-gate): scattered string compares are how silent
+        # fallbacks breed
+        return not is_host_platform(resolved_platform(self._tpu.platform))
 
     def encode_batch_hashed(
         self, blocks: list[bytes], impl: str = "auto"

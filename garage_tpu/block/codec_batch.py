@@ -20,7 +20,12 @@ that gap with a dynamic batcher in front of the codec:
 
   - the dispatch itself runs in a worker thread (`asyncio.to_thread`),
     so the codec math never blocks the event loop between any two
-    requests — the pre-batcher pipeline's real serialization point;
+    requests — the pre-batcher pipeline's real serialization point.
+    This and the power-of-two batch bucketing below it are LINT-ENFORCED
+    (ISSUE 11): graft-lint's `host-sync` family flags device round-trips
+    reachable from coroutines, and `recompile-hazard` flags compiled
+    dispatches whose batch never flowed through `ops/bucketing.py` —
+    see doc/static-analysis.md;
 
   - a dispatch error fails only that batch's waiters; a cancelled PUT
     abandons its entry without poisoning the other requests coalesced

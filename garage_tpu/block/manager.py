@@ -1000,8 +1000,13 @@ class BlockManager:
         batch = [g for g in gathered if g is not None]
         if not batch:
             return 0
-        recs = self.codec.reconstruct_batch(
-            [(pieces, [rank], blen) for _h, rank, pieces, blen in batch]
+        # worker-thread hop: the grouped reconstruction is a device
+        # dispatch + host fetch (or a long native-codec run) — inline it
+        # would stall the event loop for the whole repair batch, exactly
+        # what the codec batcher already avoids on the encode side
+        recs = await asyncio.to_thread(
+            self.codec.reconstruct_batch,
+            [(pieces, [rank], blen) for _h, rank, pieces, blen in batch],
         )
         n = 0
         for (h, rank, _p, blen), rec in zip(batch, recs):

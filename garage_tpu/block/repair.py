@@ -212,20 +212,28 @@ class ScrubWorker(Worker):
         for plen, items in groups.items():
             got = None
             if plen % 64 == 0:
-                batch = np.stack(
-                    [np.frombuffer(p, dtype=np.uint8) for *_x, p in items]
+                # worker-thread hops for the WHOLE group path: the
+                # np.stack is a megacopy of the group, blake3_batch's
+                # np.asarray is a device round-trip (host-sync), and the
+                # native fallback is a long CPU hash run — any of them
+                # dispatched inline stalls the event loop for the whole
+                # scrub batch, worst exactly on nodes already degraded
+                # to the host path
+                batch = await asyncio.to_thread(
+                    np.stack,
+                    [np.frombuffer(p, dtype=np.uint8) for *_x, p in items],
                 )
                 try:
                     from ..ops.hash_tpu import blake3_batch as jax_batch
 
-                    got = jax_batch(batch)
+                    got = await asyncio.to_thread(jax_batch, batch)
                 except Exception as e:  # noqa: BLE001 — unsupported shape/backend
                     logger.debug("scrub: jax batch hash fell back: %r", e)
                     got = None
                 if got is None:
                     from .. import _native
 
-                    got = _native.blake3_batch(batch)
+                    got = await asyncio.to_thread(_native.blake3_batch, batch)
             for idx, (h, pi, path, want, piece) in enumerate(items):
                 digest = bytes(got[idx]) if got is not None else piece_hash(piece)
                 if digest != want:

@@ -121,12 +121,18 @@ class ScrubRepairPipeline:
 
     def sharded_apply(self, mesh, data: np.ndarray):
         """Host entry for the mesh step with ANY batch size: zero-pads the
-        block batch up to a multiple of the mesh, runs the sharded step,
-        slices the pad rows back off.  Returns (parity, hashes, stats) as
-        numpy, stats covering only the real blocks."""
+        block batch to its power-of-two bucket and up to a multiple of
+        the mesh (ops/bucketing.py — one compiled step per bucket class,
+        not one per caller batch size), runs the sharded step, slices
+        the pad rows back off.  Returns (parity, hashes, stats) as
+        numpy, stats covering only the real blocks.  SYNCHRONOUS (the
+        block_until_ready is a device round-trip): async callers must
+        dispatch via asyncio.to_thread (lint rule `host-sync`)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.bucketing import pad_for_mesh
 
         # keyed by the Mesh itself (hashable): an id() key could collide
         # when a GC'd mesh's id is reused, returning a compiled step bound
@@ -138,11 +144,7 @@ class ScrubRepairPipeline:
 
         n = mesh.devices.size
         b = data.shape[0]
-        pad = (-b) % n
-        if pad:
-            data = np.concatenate(
-                [data, np.zeros((pad, *data.shape[1:]), np.uint8)]
-            )
+        data = pad_for_mesh(data, n)
         data_dev = jax.device_put(
             jnp.asarray(data), NamedSharding(mesh, P("blocks"))
         )

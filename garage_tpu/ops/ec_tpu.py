@@ -44,6 +44,14 @@ import numpy as np
 
 from ..utils.compile_cache import instrumented_cache, record_cache_event
 from . import gf, telemetry
+from .bucketing import bucket_batch, pad_for_mesh, pad_to_bucket
+
+__all__ = [
+    "bucket_batch", "pad_for_mesh", "pad_to_bucket",  # re-exported
+    "gf_bitmatmul", "gf_bitmatmul_pallas", "ec_apply_fn",
+    "ec_apply_fn_mesh", "ec_encode_hash_fn", "blake3_supported_len",
+    "EcTpu",
+]
 
 
 def _jax():
@@ -170,14 +178,15 @@ def _ec_body(plat: str, impl: str | None):
     import jax.numpy as jnp
 
     if impl is None:
-        impl = "pallas_int8" if plat not in ("cpu",) else "einsum"
+        impl = "einsum" if telemetry.is_host_platform(plat) else "pallas_int8"
 
     if impl == "einsum":
         def body(bitmat, x):
             return gf_bitmatmul(bitmat.astype(jnp.bfloat16), x)
     elif impl in ("pallas_int8", "pallas_bf16"):
         dd = "int8" if impl == "pallas_int8" else "bf16"
-        interp = plat == "cpu"  # interpreter mode for CPU tests
+        # interpreter mode for CPU tests
+        interp = telemetry.is_host_platform(plat)
 
         def body(bitmat, x):
             if _pick_tile(x.shape[-1]) == 0:
@@ -186,30 +195,6 @@ def _ec_body(plat: str, impl: str | None):
     else:
         raise ValueError(f"unknown impl {impl!r}")
     return body
-
-
-def bucket_batch(b: int) -> int:
-    """Round a block-batch size up to its power-of-two shape class.
-
-    The foreground codec batcher coalesces RAGGED batches (whatever
-    arrived during the linger window), and XLA compiles one executable
-    per input shape: unbucketed batch sizes would compile a fresh kernel
-    for every distinct concurrency level the node ever sees.  Padding
-    the batch axis to a power of two bounds the compile cache at
-    log2(max_batch) entries per shard shape; pad blocks are zeros and
-    their outputs are sliced off host-side (GF coding of a zero block is
-    zeros — nothing leaks between tenants)."""
-    if b <= 1:
-        return 1
-    return 1 << (b - 1).bit_length()
-
-
-def _pad_batch(x: np.ndarray, b_padded: int) -> np.ndarray:
-    if x.shape[0] == b_padded:
-        return x
-    return np.concatenate(
-        [x, np.zeros((b_padded - x.shape[0], *x.shape[1:]), np.uint8)]
-    )
 
 
 def _donate_kwargs(plat: str) -> dict:
@@ -221,7 +206,9 @@ def _donate_kwargs(plat: str) -> dict:
     skip it there.  Only the fused encode+hash path donates: the generic
     `ec_apply_fn` is also driven with long-lived device arrays
     (bench.py's timing loop) that a donation would invalidate."""
-    return {} if plat in ("cpu",) else {"donate_argnums": (1,)}
+    return (
+        {} if telemetry.is_host_platform(plat) else {"donate_argnums": (1,)}
+    )
 
 
 @instrumented_cache("ec_apply")
@@ -402,34 +389,41 @@ class EcTpu:
                         "repair batches fall back to single-device "
                         "dispatch", n, e,
                     )
-        try:
-            fn = ec_apply_fn(self.platform, self._impl)
-            return np.asarray(fn(bitmat, x))
-        except Exception:
-            if self._impl == "einsum":
-                raise
-            # Pallas path unavailable on this backend: pin the fallback.
-            self._impl = "einsum"
-            fn = ec_apply_fn(self.platform, self._impl)
-            return np.asarray(fn(bitmat, x))
+        b = x.shape[0]
+        bucket = bucket_batch(b)
+        record_cache_event("ec_dispatch_bucket", bucket == b)
+        for impl in dict.fromkeys((self._impl, "einsum")):
+            fn = ec_apply_fn(self.platform, impl)
+            xp = pad_to_bucket(x, bucket)
+            try:
+                # graft-lint: allow-donation(ec_apply_fn also drives long-lived bench/device arrays; donation would invalidate them)
+                out = np.asarray(fn(bitmat, xp))
+            except Exception:
+                if impl == "einsum":
+                    raise
+                # Pallas path unavailable on this backend: pin the
+                # fallback (next loop entry) and retry on einsum.
+                continue
+            self._impl = impl
+            return out[:b]
+        raise AssertionError("unreachable: einsum attempt raises on failure")
 
     def _apply_mesh(self, bitmat, x: np.ndarray, n: int) -> np.ndarray:
-        """Shard the block batch over the n-device mesh (pad to a multiple
-        of n with zero blocks, slice the result back)."""
+        """Shard the block batch over the n-device mesh: the batch axis
+        is padded to its power-of-two bucket AND to a multiple of n with
+        zero blocks (one compiled executable per bucket instead of one
+        per planner round size), then the result is sliced back."""
         jax = _jax()
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         b = x.shape[0]
-        pad = (-b) % n
-        if pad:
-            x = np.concatenate(
-                [np.asarray(x), np.zeros((pad, *x.shape[1:]), np.uint8)]
-            )
+        xp = pad_for_mesh(x, n)
         fn, mesh = ec_apply_fn_mesh(self.platform, self._impl, n)
-        xd = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("blocks")))
+        xd = jax.device_put(jnp.asarray(xp), NamedSharding(mesh, P("blocks")))
+        # graft-lint: allow-donation(mesh fallback retries the same host batch single-device; a donated input would already be gone)
         out = np.asarray(fn(bitmat, xd))
-        return out[:b] if pad else out
+        return out[:b]
 
     def encode(self, data: np.ndarray) -> np.ndarray:
         """(B, k, S) data shards -> (B, m, S) parity shards."""
@@ -455,11 +449,19 @@ class EcTpu:
             return self.encode(data), None
         bucket = bucket_batch(b)
         record_cache_event("ec_batch_bucket", bucket == b)
-        x = _pad_batch(np.asarray(data), bucket)
         plat = telemetry.resolved_platform(self.platform)
         for impl in dict.fromkeys((self._impl, "einsum")):
             try:
                 fn = ec_encode_hash_fn(self.platform, impl, s)
+                # the shard input is DONATED on device backends.  Host
+                # numpy inputs survive donation (JAX donates the
+                # transient device copy, never the host buffer), so
+                # today's retry is safe either way — the rebind inside
+                # the loop is the donation rule's retry idiom, kept
+                # honest for the day a caller hands this path a
+                # device-resident batch (ROADMAP item 2's AOT/pjit
+                # migration), where attempt 1 WOULD consume the buffer
+                x = pad_to_bucket(np.asarray(data), bucket)
                 with telemetry.dispatch("ec_encode_hash", plat, b, data.nbytes):
                     parity, hashes = fn(self._enc_bitmat, x)
                     parity, hashes = np.asarray(parity), np.asarray(hashes)

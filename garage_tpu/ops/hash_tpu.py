@@ -25,6 +25,7 @@ import numpy as np
 from ..utils.compile_cache import instrumented_cache
 from . import telemetry
 from .blake3_ref import CHUNK_END, CHUNK_START, IV, MSG_PERMUTATION, PARENT, ROOT
+from .bucketing import bucket_batch, pad_to_bucket
 
 BLOCK_LEN = 64
 CHUNK_LEN = 1024
@@ -198,12 +199,22 @@ def _hasher_for_len(length: int):
 
 
 def blake3_batch(x: np.ndarray) -> np.ndarray:
-    """x: (B, L) uint8 -> (B, 32) uint8 official BLAKE3 digests."""
+    """x: (B, L) uint8 -> (B, 32) uint8 official BLAKE3 digests.
+
+    The batch axis is padded to its power-of-two bucket (scrub hands
+    this whatever group sizes the piece inventory produced — unbucketed,
+    every distinct group size would compile a fresh executable); pad
+    rows hash independently and are sliced off.  SYNCHRONOUS: the
+    np.asarray is a device round-trip — async callers must dispatch via
+    asyncio.to_thread (lint rule `host-sync`, the scrub path does)."""
+    b = x.shape[0]
     fn = _hasher_for_len(x.shape[1])
+    xp = pad_to_bucket(np.asarray(x), bucket_batch(b))
     with telemetry.dispatch(
-        "blake3_hash", telemetry.resolved_platform(), x.shape[0], x.nbytes
+        "blake3_hash", telemetry.resolved_platform(), b, x.nbytes
     ):
-        return np.asarray(fn(x))
+        # graft-lint: allow-donation(callers retain and re-read the host batch; the hasher also serves fused pipelines with long-lived inputs)
+        return np.asarray(fn(xp))[:b]
 
 
 def blake3_batch_fn(length: int):

@@ -57,6 +57,18 @@ def resolved_platform(pin: str | None = None) -> str:
         return "unknown"
 
 
+def is_host_platform(platform: str | None) -> bool:
+    """THE definition of "this dispatch would run on the host" — the
+    one backend-string comparison the codec surface is allowed (and
+    lint-forced, rule `backend-gate`) to route through.  Scattered
+    `plat == "cpu"` checks are how PR 4's silent single-device fallback
+    stayed invisible; a shared gate keeps every fallback decision
+    consistent and greppable.  Unresolved/unknown platforms count as
+    host: never prefer the device path on a backend we could not even
+    name."""
+    return platform is None or platform in ("cpu", "unknown", "")
+
+
 def platforms_seen() -> list[str]:
     """Backends that have actually served a dispatch in this process
     (the label set behind the `jax_backend_platform` gauge) — consumed

@@ -88,11 +88,13 @@ def enable_persistent_cache(path: str | None = None) -> str:
         return path
     # cheap env check first: CPU-pinned children (bench.py cpu_env, the
     # test suite) never initialize a backend just to learn it's cpu
+    # graft-lint: allow-backend-gate(pre-jax-import probe: routing through ops.telemetry would initialize the backend this check exists to avoid)
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         return ""
 
     import jax
 
+    # graft-lint: allow-backend-gate(CPU cache opt-out is the documented design of this module; the resolved backend is the probe result itself)
     if jax.default_backend() == "cpu":
         return ""
     os.makedirs(path, exist_ok=True)

@@ -10,7 +10,9 @@
     python script/graft_lint.py --json               # machine-readable
 
 Exit codes: 0 clean (every finding is baselined), 1 new violations (or,
-with --strict, stale baseline entries), 2 usage error.
+with --strict, stale baseline entries), 2 usage error — including a
+rule family blowing the `--max-rule-msec` wall-time budget (the
+12-family plane must not rot the pre-commit loop).
 
 `--diff [REF]` (default HEAD) lints only the .py files changed vs the
 git ref — the fast pre-commit loop; the full-repo run stays the tier-1
@@ -103,6 +105,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as JSON (includes per-rule "
                          "timings)")
+    ap.add_argument("--max-rule-msec", type=float, default=None,
+                    metavar="MSEC",
+                    help="per-rule-family wall-time budget: exit 2 when "
+                         "any family exceeds it (the 12-family plane "
+                         "must not rot the pre-commit loop; tier-1 "
+                         "asserts the full run stays under budget)")
     ap.add_argument("--strict", action="store_true",
                     help="also fail on stale baseline entries (debt that "
                          "was paid but not re-triaged)")
@@ -173,14 +181,26 @@ def main(argv: list[str] | None = None) -> int:
             return 2
     new, stale = diff_baseline(violations, baseline)
 
+    over_budget = {}
+    if args.max_rule_msec is not None:
+        over_budget = {
+            k: round(t * 1000.0, 1)
+            for k, t in sorted(timings.items())
+            if t * 1000.0 > args.max_rule_msec
+        }
+
     if args.as_json:
-        print(json.dumps({
+        obj = {
             "total": len(violations),
             "new": [v.__dict__ | {"key": v.key} for v in new],
             "baselined": len(violations) - len(new),
             "stale_baseline_keys": stale,
             "timings": {k: round(t, 4) for k, t in sorted(timings.items())},
-        }, indent=2))
+        }
+        if args.max_rule_msec is not None:
+            obj["budget_msec"] = args.max_rule_msec
+            obj["over_budget"] = over_budget
+        print(json.dumps(obj, indent=2))
     else:
         for v in new:
             print(v.render())
@@ -195,6 +215,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"graft-lint: clean ({len(violations)} total, "
                   f"{known} baselined, {len(stale)} stale)")
 
+    if over_budget:
+        # a rotted rule family is a usage-class failure (the pre-commit
+        # loop depends on the whole plane staying fast), distinct from
+        # "the code has violations"
+        print(
+            "graft-lint: rule budget exceeded "
+            f"(--max-rule-msec {args.max_rule_msec:g}): "
+            + ", ".join(f"{k}={v}ms" for k, v in over_budget.items()),
+            file=sys.stderr,
+        )
+        return 2
     if new:
         return 1
     if stale and args.strict:

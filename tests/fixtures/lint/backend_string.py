@@ -1,0 +1,34 @@
+"""backend-gate (platform-compare) negative fixture.
+
+`bad_gate`/`bad_env_gate` compare backend strings outside the declared
+probe/telemetry modules; `ok_config_key` (nothing platform-ish on the
+other side) and `ok_pragma` must stay quiet.  Never imported — only
+parsed.
+"""
+
+import os
+
+
+def resolved():
+    return "cpu"
+
+
+def bad_gate():
+    plat = resolved()
+    if plat == "cpu":  # scattered backend gate: silent-fallback breeding
+        return "host"
+    return "device"
+
+
+def bad_env_gate():
+    return os.environ.get("JAX_PLATFORMS", "") in ("cpu", "tpu")
+
+
+def ok_config_key(k):
+    return k == "tpu"  # a config key, not a backend gate: quiet
+
+
+def ok_pragma():
+    backend = resolved()
+    # graft-lint: allow-backend-gate(fixture: declared probe decision)
+    return backend == "tpu"

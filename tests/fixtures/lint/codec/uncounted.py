@@ -1,0 +1,32 @@
+"""backend-gate (uncounted-codec-path) negative fixture.
+
+The `codec/` subdirectory is load-bearing: the sub-rule scopes to
+`/codec/` modules.  `encode_batch` dispatches to the device codec
+without counting `block_codec_*{path}`; the counted and pragma'd
+variants must stay quiet.  Never imported — only parsed.
+"""
+
+
+def _count(op, path, blocks, nbytes):
+    pass
+
+
+class FakeTpu:
+    def encode(self, data):
+        return data
+
+
+class UncountedCodec:
+    def __init__(self):
+        self._tpu = FakeTpu()
+
+    def encode_batch(self, blocks):
+        return self._tpu.encode(blocks)  # dispatch with no path counter
+
+    def encode_counted(self, blocks):
+        _count("encode", "tpu", len(blocks), 0)
+        return self._tpu.encode(blocks)
+
+    def encode_pragma(self, blocks):
+        # graft-lint: allow-backend-gate(fixture: counted at the caller)
+        return self._tpu.encode(blocks)

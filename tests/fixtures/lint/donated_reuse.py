@@ -1,0 +1,104 @@
+"""use-after-donation negative fixture.
+
+`use_after` reads a donated buffer after the dispatch; `loop_reuse`
+re-drives a buffer donated on iteration 1; `advisory_undonated` is a
+bucketed dispatch with no donation (advisory).  The `ok_*` variants
+(fresh rebind per iteration, last-use, pragma) must stay quiet.  Never
+imported — only parsed.
+"""
+
+import jax
+import numpy as np
+
+
+def pad_to_bucket(x, b):  # recognized pad helper (the NAME is load-bearing)
+    return x
+
+
+def make_donating():
+    def body(m, x):
+        return x * m
+
+    return jax.jit(body, donate_argnums=(1,))
+
+
+def use_after(m, batch):
+    fn = make_donating()
+    y = fn(m, batch)
+    return y, batch.sum()  # reads the buffer XLA just deleted
+
+
+def loop_reuse(m, batch):
+    fn = make_donating()
+    out = None
+    for _ in range(2):
+        out = fn(m, batch)  # iteration 2 re-reads iteration 1's donation
+    return out
+
+
+def ok_rebind(m, chunks):
+    fn = make_donating()
+    out = None
+    for chunk in chunks:
+        batch = np.stack(chunk)
+        out = fn(m, batch)  # fresh buffer per attempt: clean
+    return out
+
+
+def ok_last_use(m, batch):
+    fn = make_donating()
+    return fn(m, batch)  # never read again: clean
+
+
+def ok_exclusive_branch(m, batch, use_dev):
+    fn = make_donating()
+    if use_dev:
+        y = fn(m, batch)
+        return y
+    return batch.sum()  # host fallback: can never follow the donation
+
+
+def ok_sibling_arms(m, batch, use_dev):
+    fn = make_donating()
+    if use_dev:
+        out = fn(m, batch)
+    else:
+        out = batch.sum()  # the OTHER arm of the dispatch's if: clean
+    return out
+
+
+def ok_for_target(m, batches):
+    fn = make_donating()
+    out = []
+    for data in batches:  # the for-target IS the per-iteration rebind
+        out.append(fn(m, data))
+    return out
+
+
+def ok_rebind_after_dispatch(m, batches):
+    fn = make_donating()
+    batch = batches[0]
+    out = None
+    for nxt in batches[1:]:
+        out = fn(m, batch)
+        batch = nxt  # producer/consumer: fresh buffer for the NEXT turn
+    return out
+
+
+def advisory_undonated(m, batch):
+    def body2(m2, x):
+        return x + m2
+
+    fn = jax.jit(body2)
+    xp = pad_to_bucket(batch, 8)
+    return fn(m, xp)  # dispatch-sized batch, no donate_argnums: advisory
+
+
+def ok_advisory_pragma(m, batch):
+    def body3(m2, x):
+        return x - m2
+
+    fn = jax.jit(body3)
+    xp = pad_to_bucket(batch, 8)
+    # graft-lint: allow-donation(fixture: input is long-lived by design)
+    return fn(m, xp)

@@ -1,0 +1,58 @@
+"""host-sync negative fixture: device->host sync points on the loop.
+
+`direct_sync`/`until_ready` sync in the coroutine itself;
+`indirect_sync` reaches the sync point through one sync helper hop;
+the `ok_*` variants (to_thread hop, plain-numpy asarray, pragma) must
+stay quiet.  Never imported — only parsed.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+
+
+def make_fn():
+    def body(x):
+        return x + 1
+
+    return jax.jit(body)
+
+
+async def direct_sync():
+    fn = make_fn()
+    y = fn(np.zeros(4, np.uint8))
+    return np.asarray(y)  # host-sync: materializes the jit result
+
+
+async def until_ready():
+    fn = make_fn()
+    y = fn(np.zeros(4, np.uint8))
+    y.block_until_ready()  # host-sync: full device round-trip
+    return float(y)  # host-sync: scalar extraction syncs too
+
+
+def helper_fetch():
+    fn = make_fn()
+    return np.asarray(fn(np.zeros(4, np.uint8)))  # flagged via the chain
+
+
+async def indirect_sync():
+    return helper_fetch()  # reaches the sync point one hop down
+
+
+async def ok_to_thread():
+    # the approved remedy: the sync point runs on a worker thread
+    return await asyncio.to_thread(helper_fetch)
+
+
+async def ok_plain_numpy():
+    arr = np.frombuffer(b"\x00\x01", dtype=np.uint8)
+    return np.asarray(arr)  # no device provenance: quiet
+
+
+async def ok_pragma():
+    fn = make_fn()
+    y = fn(np.zeros(4, np.uint8))
+    # graft-lint: allow-host-sync(fixture: one-shot probe fetch is the design)
+    return np.asarray(y)

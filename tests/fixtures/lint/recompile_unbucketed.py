@@ -1,0 +1,59 @@
+"""recompile-hazard negative fixture: shape-discipline violations.
+
+`bad_dispatch` drives a compiled callable with an unbucketed batch;
+`make_branchy` hands jit a def with Python control flow on a traced
+parameter.  The `ok_*` variants (pad-helper provenance, shape-attribute
+branches, `is None` tests, pragma) must stay quiet.  Never imported —
+only parsed.
+"""
+
+import jax
+
+
+def pad_to_bucket(x, b):  # recognized pad helper (the NAME is load-bearing)
+    return x
+
+
+def make_fn():
+    def body(x):
+        return x * 2
+
+    return jax.jit(body)
+
+
+def bad_dispatch(batch):
+    fn = make_fn()
+    return fn(batch)  # unbucketed: every batch size compiles fresh
+
+
+def ok_dispatch(batch):
+    fn = make_fn()
+    xp = pad_to_bucket(batch, 8)
+    return fn(xp)  # bucketed: one executable per shape class
+
+
+def ok_wrapped_provenance(batch):
+    fn = make_fn()
+    xp = pad_to_bucket(batch, 8)
+    return fn(jax.device_put(xp))  # wrapper calls preserve provenance
+
+
+def ok_pragma(batch):
+    fn = make_fn()
+    # graft-lint: allow-recompile(fixture: one-shot probe at a fixed shape)
+    return fn(batch)
+
+
+def make_branchy():
+    def body(x, flag):
+        if flag:  # traced-branch: re-traces per value
+            return x
+        if x.shape[0] > 2:  # quiet: shapes are static at trace time
+            return x * 2
+        if flag is None:  # quiet: `is None` dispatches at trace time
+            return x
+        for _v in x:  # traced-branch: iterating a tracer
+            pass
+        return x + 1
+
+    return jax.jit(body)

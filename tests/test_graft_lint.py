@@ -38,6 +38,18 @@ FIXTURES = "tests/fixtures/lint"
 # the knob rule needs the section-dataclass inventory from config.py
 CONFIG = "garage_tpu/utils/config.py"
 
+ALL_FAMILIES = {
+    "loop-blocker", "orphan-task", "swallowed-exception",
+    "resource-discipline", "cancel-safety", "lock-await",
+    "trust-boundary", "wire-compat",
+    "host-sync", "recompile-hazard", "use-after-donation", "backend-gate",
+}
+
+# tier-1 per-rule-family wall budget (msec): the slowest family measures
+# ~0.6 s on the slow CI box, so 2 s is margin, not slack — a family that
+# blows it has rotted the pre-commit loop
+RULE_BUDGET_MSEC = 2000
+
 
 def lint(*paths, rules=None):
     return analyze(REPO, list(paths), rules)
@@ -223,6 +235,85 @@ def test_fixture_deep_resolution_fires():
         "Planner.checkpoint_annotated",  # p: "FilePersister | None"
     }
     assert all("FilePersister.save" in v.detail for v in vs)
+
+
+def test_fixture_host_sync_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/host_sync_async.py")
+        if v.rule == "host-sync"
+    ]
+    by_symbol = {v.symbol for v in vs}
+    details = {v.detail for v in vs}
+    # direct sync points in the coroutine
+    assert "direct_sync" in by_symbol
+    # block_until_ready AND the scalar extraction both fire
+    assert "block_until_ready" in details
+    assert "float" in details
+    # propagated through one sync helper hop, attributed to the helper
+    assert any(d.startswith("np.asarray|helper_fetch") for d in details)
+    # to_thread hop, plain-numpy asarray, and pragma stay quiet
+    assert by_symbol == {"direct_sync", "until_ready", "indirect_sync"}
+
+
+def test_fixture_recompile_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/recompile_unbucketed.py")
+        if v.rule == "recompile-hazard"
+    ]
+    details = {v.detail for v in vs}
+    symbols = {v.symbol for v in vs}
+    # unbucketed dispatch fires; pad-provenance (direct + through a
+    # wrapper call) and the pragma stay quiet
+    assert "unbucketed-dispatch:fn" in details
+    assert "bad_dispatch" in symbols
+    assert "ok_dispatch" not in symbols
+    assert "ok_wrapped_provenance" not in symbols
+    assert "ok_pragma" not in symbols
+    # python control flow on a traced param fires (if + for); shape
+    # attributes and `is None` stay quiet
+    assert "traced-branch:flag" in details
+    assert "traced-branch:x" in details
+    assert len([d for d in details if d.startswith("traced-branch")]) == 2
+
+
+def test_fixture_donation_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/donated_reuse.py")
+        if v.rule == "use-after-donation"
+    ]
+    details = {v.detail for v in vs}
+    symbols = {v.symbol for v in vs}
+    assert "use-after-donation:fn:batch" in details
+    assert "donated-reuse-in-loop:fn:batch" in details
+    # the advisory fires on the undonated bucketed dispatch
+    assert "undonated-dispatch:fn" in details
+    # fresh-rebind-per-iteration, last-use, and the pragma stay quiet
+    assert symbols == {"use_after", "loop_reuse", "advisory_undonated"}
+
+
+def test_fixture_backend_gate_fires():
+    vs = [
+        v for v in lint(f"{FIXTURES}/backend_string.py")
+        if v.rule == "backend-gate"
+    ]
+    symbols = {v.symbol for v in vs}
+    assert symbols == {"bad_gate", "bad_env_gate"}
+    assert all(v.detail.startswith("platform-compare:") for v in vs)
+    # a config-key compare and the pragma'd probe stay quiet (asserted
+    # by the symbol set above: the fixture contains both)
+
+
+def test_fixture_uncounted_codec_path_fires():
+    """The codec/ subdirectory is load-bearing: the sub-rule scopes to
+    /codec/ modules."""
+    vs = [
+        v for v in lint(f"{FIXTURES}/codec/uncounted.py")
+        if v.rule == "backend-gate"
+    ]
+    assert len(vs) == 1
+    assert vs[0].symbol == "UncountedCodec.encode_batch"
+    assert vs[0].detail == "uncounted-codec-path:encode_batch"
+    # counted and pragma'd dispatches stay quiet
 
 
 def test_fixture_crdt_mutation_fires():
@@ -442,10 +533,12 @@ def test_analyzer_imports_stdlib_only():
     stdlib = set(_sys.stdlib_module_names)
     adir = os.path.join(REPO, "garage_tpu", "analysis")
     present = {n for n in os.listdir(adir) if n.endswith(".py")}
-    # the guard must actually cover the ISSUE 10 rule files — a rename
-    # would silently drop them from this loop
+    # the guard must actually cover the ISSUE 10 + ISSUE 11 rule files —
+    # a rename would silently drop them from this loop
     assert {
         "cancel_safety.py", "lock_await.py", "taint.py", "wire_compat.py",
+        "host_sync.py", "recompile.py", "donation.py", "backend_gate.py",
+        "device_model.py",
     } <= present
     for name in sorted(present):
         tree = ast.parse(open(os.path.join(adir, name)).read())
@@ -485,11 +578,7 @@ def test_cli_exit_codes():
     assert r.returncode == 1
     obj = json.loads(r.stdout)
     assert len(obj["new"]) == 2
-    assert set(obj["timings"]) == {
-        "loop-blocker", "orphan-task", "swallowed-exception",
-        "resource-discipline", "cancel-safety", "lock-await",
-        "trust-boundary", "wire-compat",
-    }
+    assert set(obj["timings"]) == ALL_FAMILIES
     assert all(t >= 0 for t in obj["timings"].values())
 
 
@@ -511,6 +600,126 @@ def test_cli_diff_mode():
     )
     assert r.returncode == 2
     assert "git diff" in r.stderr
+
+
+def test_cli_rules_selection():
+    """--rules runs exactly the named families — including the ISSUE 11
+    accelerator set — and an unknown family is a usage error."""
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    r = subprocess.run(
+        [sys.executable, script, "--no-baseline", "--json",
+         "--rules", "host-sync,backend-gate",
+         f"{FIXTURES}/backend_string.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 1
+    obj = json.loads(r.stdout)
+    assert set(obj["timings"]) == {"host-sync", "backend-gate"}
+    assert all(v["rule"] == "backend-gate" for v in obj["new"])
+    r = subprocess.run(
+        [sys.executable, script, "--rules", "no-such-family"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 2
+    assert "unknown rule" in r.stderr
+
+
+def test_cli_rule_budget_holds_at_tier1():
+    """Acceptance: the full 12-family run over the whole package stays
+    under the declared per-rule budget — the plane must not rot the
+    pre-commit loop as families accrete."""
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    r = subprocess.run(
+        [sys.executable, script, "--json",
+         "--max-rule-msec", str(RULE_BUDGET_MSEC)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    obj = json.loads(r.stdout)
+    assert set(obj["timings"]) == ALL_FAMILIES
+    assert obj["budget_msec"] == RULE_BUDGET_MSEC
+    assert obj["over_budget"] == {}
+
+
+def test_cli_rule_budget_exceeded_is_exit_2():
+    """An impossible budget trips every family: exit 2 (usage-class,
+    distinct from exit 1 = violations) and the offenders are named."""
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    r = subprocess.run(
+        [sys.executable, script, "--json", "--max-rule-msec", "0",
+         f"{FIXTURES}/backend_string.py"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 2
+    assert "rule budget exceeded" in r.stderr
+    obj = json.loads(r.stdout)
+    assert obj["over_budget"]  # every family is over a 0 ms budget
+
+
+def test_cli_diff_previous_commit_smoke():
+    """`--diff HEAD~1` (the post-commit sanity loop) lints whatever the
+    last commit touched, against the committed baseline: a committed
+    tree must come out clean."""
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    r = subprocess.run(
+        [sys.executable, script, "--diff", "HEAD~1"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_diff_untracked_union_catches_accelerator_rules():
+    """Regression for the PR 10 untracked-file union: a brand-new
+    (never-committed) file full of accelerator hazards must fail
+    --diff, which `git diff` alone would never list."""
+    script = os.path.join(REPO, "script", "graft_lint.py")
+    scratch = os.path.join(REPO, "garage_tpu", "_lint_scratch_issue11.py")
+    src = (
+        "import asyncio\n"
+        "import jax\n"
+        "import numpy as np\n"
+        "def make_fn():\n"
+        "    def body(x):\n"
+        "        return x + 1\n"
+        "    return jax.jit(body)\n"
+        "async def bad(plat):\n"
+        "    fn = make_fn()\n"
+        "    if plat == 'cpu':\n"
+        "        return None\n"
+        "    return np.asarray(fn(np.zeros(4, np.uint8)))\n"
+    )
+    try:
+        with open(scratch, "w", encoding="utf-8") as f:
+            f.write(src)
+        r = subprocess.run(
+            [sys.executable, script, "--diff", "HEAD"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "host-sync" in r.stdout
+        assert "recompile-hazard" in r.stdout
+        assert "backend-gate" in r.stdout
+    finally:
+        os.remove(scratch)
+
+
+@pytest.mark.slow
+def test_sanitize_all_alongside_lint_gate():
+    """CI-style pairing (ISSUE 11 satellite): the native sanitizer
+    sweep runs next to the lint gate — one summary table, PASS on every
+    mode."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("g++ unavailable")
+    r = subprocess.run(
+        [os.path.join(REPO, "script", "sanitize-native.sh"), "--all"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sanitize-native summary" in r.stdout
+    for mode in ("tsan", "asan", "ubsan"):
+        assert f"{mode}\tPASS" in r.stdout, r.stdout
 
 
 def test_reap_propagates_caller_cancellation():
