@@ -168,6 +168,100 @@ async def run_bigget(tmp_path, size: int, depths: list[int]) -> dict:
         await stop_cluster(garages, [s3], [client])
 
 
+async def run_read_heavy_cluster(
+    tmp_path, mode: str, n_nodes: int, n_objects: int, n_reads: int,
+    size: int, zipf_s: float, block_size: int, concurrency: int = 4,
+) -> dict:
+    """GET-dominant (90/10) zipfian workload against one cluster mode.
+    Returns client-side GET/PUT percentiles, the server-side GET phase
+    waterfall, and (for the EC run) what the traffic observatory saw —
+    including top-K precision vs the ground-truth hot set the bench
+    itself generated."""
+    import random
+    import time
+    from collections import Counter
+
+    from test_ec_cluster import stop_cluster
+
+    from garage_tpu.rpc import traffic as traffic_mod
+    from garage_tpu.utils import latency as latency_mod
+
+    garages, s3, client = await boot_bench_cluster(
+        tmp_path, mode, n=n_nodes, block_size=block_size
+    )
+    # the overload plane has its own bench (--overload); here it would
+    # rewrite the workload mid-measurement (an in-process 11-node
+    # cluster easily burns the default latency SLO, the ladder steps to
+    # shed-write, and the 90/10 mix 503s).  Pin its signals calm — the
+    # read path is what's being measured.
+    for g in garages:
+        if g.shedder is not None:
+            g.shedder.signals = lambda consume=True: (0.0, 0.0)
+        g.overload.set_shed_tier(None)
+    try:
+        await client.create_bucket("bench")
+        body = os.urandom(size)
+
+        async def populate(w: int) -> None:
+            for i in range(w, n_objects, 8):
+                await client.put_object("bench", f"o{i:05d}", body)
+
+        await asyncio.gather(*[populate(w) for w in range(8)])
+
+        # ground-truth zipfian access sequence, GET-dominant with a 10%
+        # PUT refresh mix (same popularity law for both)
+        rng = random.Random(20260804)
+        weights = [1.0 / (i + 1) ** zipf_s for i in range(n_objects)]
+        seq = rng.choices(range(n_objects), weights, k=n_reads)
+        true_gets = Counter(i for n, i in enumerate(seq) if n % 10 != 0)
+
+        latency_mod.aggregator.reset()
+        traffic_mod.observatory.reset()
+        get_times: list[float] = []
+        put_times: list[float] = []
+
+        async def worker(w: int) -> None:
+            for n in range(w, len(seq), concurrency):
+                i = seq[n]
+                t0 = time.perf_counter()
+                if n % 10 == 0:
+                    await client.put_object("bench", f"o{i:05d}", body)
+                    put_times.append(time.perf_counter() - t0)
+                else:
+                    await client.get_object("bench", f"o{i:05d}")
+                    get_times.append(time.perf_counter() - t0)
+
+        await asyncio.gather(*[worker(w) for w in range(concurrency)])
+        await asyncio.sleep(0.05)  # trailing in-process records land
+
+        snap = traffic_mod.observatory.snapshot()
+        got = [
+            o["key"] for o in snap["hotObjects"]
+            if o["bucket"] == "bench"
+        ][:10]
+        want = {f"o{i:05d}" for i, _ in true_gets.most_common(10)}
+        return {
+            "get_p50": _pct(get_times, 0.5),
+            "get_p99": _pct(get_times, 0.99),
+            "put_p99": _pct(put_times, 0.99) if put_times else None,
+            "phases": _phase_summary(
+                latency_mod.aggregator.snapshot().get("get")
+            ),
+            "observatory": {
+                "topk_precision": round(len(set(got) & want) / 10, 2),
+                "top_objects": snap["hotObjects"][:5],
+                "zipf_estimate": snap["zipfS"],
+                "read_fraction": snap["readFraction"],
+                "hot_bucket": (
+                    snap["hotBuckets"][0]["bucket"]
+                    if snap["hotBuckets"] else None
+                ),
+            },
+        }
+    finally:
+        await stop_cluster(garages, [s3], [client])
+
+
 async def run_overload(
     tmp_path, k: int, m: int, duration: float, slo_ms: float
 ) -> dict:
@@ -267,6 +361,16 @@ async def main() -> None:
         "concurrent-client counts, e.g. 1,16,64 — runs the EC-vs-replica "
         "geometry at each level and records per-phase stats per level",
     )
+    ap.add_argument(
+        "--read-heavy", action="store_true",
+        help="ISSUE 12: GET-dominant (90/10) zipfian workload — banks "
+        "the EC-vs-replica GET p99 baseline (+ phase shares + "
+        "observatory top-K) the read-path PR must beat",
+    )
+    ap.add_argument("--reads", type=int, default=240,
+                    help="read-heavy mode: total mixed requests")
+    ap.add_argument("--zipf-s", type=float, default=1.2,
+                    help="read-heavy mode: key-popularity zipf exponent")
     args = ap.parse_args()
 
     if args.bigget:
@@ -302,6 +406,63 @@ async def main() -> None:
     if not m:
         raise SystemExit(f"bad --ec {args.ec!r}, want ec:k:m")
     k, mm = int(m.group(1)), int(m.group(2))
+
+    if args.read_heavy:
+        with tempfile.TemporaryDirectory() as d1:
+            rep = await run_read_heavy_cluster(
+                pathlib.Path(d1), "3", 3, args.objects, args.reads,
+                args.size, args.zipf_s, args.block_size,
+            )
+        with tempfile.TemporaryDirectory() as d2:
+            ec = await run_read_heavy_cluster(
+                pathlib.Path(d2), args.ec, k + mm, args.objects,
+                args.reads, args.size, args.zipf_s, args.block_size,
+            )
+        ratio = (
+            ec["get_p99"] / rep["get_p99"]
+            if rep["get_p99"] and ec["get_p99"]
+            else None
+        )
+
+        def _rms(res: dict) -> dict:
+            return {
+                k_: round(v * 1000, 2) if v else None
+                for k_, v in res.items()
+                if k_ in ("get_p50", "get_p99", "put_p99")
+            }
+
+        result = {
+            "metric": "s3_get_p99_ec_over_replica",
+            # the committed BEFORE number for ROADMAP item 1: the
+            # read-path PR targets <= 2.0 and will add the ceiling floor
+            "value": round(ratio, 3) if ratio else None,
+            "unit": "ratio (read-heavy zipfian, 90% GET)",
+            "vs_baseline": round(2.0 / ratio, 3) if ratio else None,
+            "detail": {
+                "geometry": args.ec,
+                "replica_nodes": 3,
+                "ec_nodes": k + mm,
+                "objects": args.objects,
+                "reads": args.reads,
+                "size": args.size,
+                "block_size": args.block_size,
+                "zipf_s": args.zipf_s,
+                "read_fraction": 0.9,
+                "replica_ms": _rms(rep),
+                "ec_ms": _rms(ec),
+                "phases": {"replica": rep["phases"], "ec": ec["phases"]},
+                # what the observatory reported for the EC run — the
+                # precision datum doubles as an end-to-end check that
+                # the measurement plane sees the workload it will tune
+                "observatory": ec["observatory"],
+            },
+        }
+        line = json.dumps(result)
+        print(line)
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                f.write(line + "\n")
+        return
 
     if args.overload:
         with tempfile.TemporaryDirectory() as d:

@@ -256,6 +256,24 @@ class AdminApiServer:
 
             return web.json_response(rollup(g))
 
+        if path == "/v1/traffic" and request.method == "GET":
+            # traffic observatory (rpc/traffic.py): local hot-object /
+            # hot-bucket top-K, op mix, size histogram, zipf skew, the
+            # slow-peer piece-fetch ranking, and the cluster rollup from
+            # the gossiped trf.* digest keys.  Per-key data lives HERE,
+            # never as Prometheus series (cardinality guard).
+            from ...rpc.traffic import traffic_response
+
+            return web.json_response(traffic_response(g))
+
+        if path == "/v1/traffic/profile" and request.method == "GET":
+            # replayable workload profile: op mix + size distribution +
+            # popularity skew + inter-arrival stats — the contract the
+            # workload generator (ROADMAP item 5) consumes
+            from ...rpc.traffic import profile_response
+
+            return web.json_response(profile_response(g))
+
         if path == "/v1/debug/profile" and request.method == "GET":
             # flight recorder: on-demand sampling profiler (utils/flight.py).
             # Folded-stack text by default; ?format=speedscope for JSON.

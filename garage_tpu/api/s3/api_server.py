@@ -137,6 +137,53 @@ class S3ApiServer:
     async def _admitted_entry(
         self, request: web.Request, lead_secs: float = 0.0
     ) -> web.StreamResponse:
+        # traffic observatory (rpc/traffic.py): every ADMITTED request
+        # feeds the hot-object/op-mix sketches — shed 503s never reach
+        # here, consistent with the overload plane's "sheds are not
+        # traffic" invariant.  Runs in the finally so errored requests
+        # (they are traffic too) still count.
+        import time
+
+        from ...rpc.traffic import observatory
+
+        t0 = time.perf_counter()
+        resp: web.StreamResponse | None = None
+        try:
+            resp = await self._instrumented_entry(request, lead_secs)
+            return resp
+        finally:
+            if observatory.enabled:
+                try:
+                    bucket_name, obj_key = self._parse_target(request)
+                    # canary probes are synthetic: recording them would
+                    # make an idle cluster report the canary bucket as
+                    # its hot bucket and bake probe noise into the
+                    # replayable workload profile (the prober has its
+                    # own canary_* telemetry families)
+                    if bucket_name != self.garage.config.admin.canary_bucket:
+                        observatory.record_http(
+                            request.method, bucket_name, obj_key,
+                            request.query,
+                            self._moved_bytes(request, resp),
+                            time.perf_counter() - t0,
+                        )
+                except Exception as e:  # noqa: BLE001
+                    logger.debug("traffic record failed: %r", e)
+
+    @staticmethod
+    def _moved_bytes(request, resp) -> int:
+        """Object-payload bytes a request moved, best effort: uploads
+        report the request body, downloads the response body (streamed
+        GETs set Content-Length before prepare)."""
+        if request.method in ("PUT", "POST"):
+            return int(request.content_length or 0)
+        if resp is not None and resp.content_length:
+            return int(resp.content_length)
+        return 0
+
+    async def _instrumented_entry(
+        self, request: web.Request, lead_secs: float = 0.0
+    ) -> web.StreamResponse:
         from ...utils.metrics import registry, request_metrics
         from ...utils.tracing import tracer
 

@@ -821,6 +821,9 @@ class BlockManager:
             if found[1]:
                 stored = zstandard.decompress(stored)
             return unwrap_piece(stored)
+        import time
+
+        t0 = time.perf_counter()
         resp = await self.helper.call(
             self.endpoint, node, ["Get", hash32, piece], prio=prio,
             order_tag=order_tag, idempotent=True,
@@ -828,7 +831,22 @@ class BlockManager:
         meta, stored = await _resp_payload(resp, budget=self.buffers)
         if meta.get("c"):
             stored = zstandard.decompress(stored)
-        return unwrap_piece(stored)
+        blen, data = unwrap_piece(stored)
+        self._note_piece_fetch(node, time.perf_counter() - t0, len(data))
+        return blen, data
+
+    def _note_piece_fetch(self, node: bytes, secs: float, nbytes: int) -> None:
+        """Per-peer EC read attribution (rpc/traffic.py): the peer-health
+        EWMAs feed the /v1/traffic slow-rank ranking, the histogram feeds
+        the per-peer piece-fetch p99 Grafana panel.  The `peer` label is
+        bounded by cluster membership (same space the breaker families
+        use) — never a key or bucket."""
+        from ..utils.metrics import registry
+
+        self.helper.health.record_piece_fetch(node, secs, nbytes)
+        lbl = (("peer", node.hex()[:16]),)
+        registry.observe("block_piece_fetch_duration", lbl, secs)
+        registry.incr("block_piece_fetch_bytes_total", lbl, by=nbytes)
 
     async def gather_pieces(
         self, hash32: bytes, want_k: int, prio=PRIO_NORMAL, exclude_self=False,

@@ -622,3 +622,16 @@ class AdminRpcHandler:
         from ..rpc.telemetry_digest import rollup
 
         return rollup(self.garage)
+
+    async def op_traffic(self, args) -> Any:
+        """Traffic observatory (rpc/traffic.py): hot objects/buckets,
+        op mix, skew, slow-peer ranking, cluster rollup — `cluster hot`."""
+        from ..rpc.traffic import traffic_response
+
+        return traffic_response(self.garage)
+
+    async def op_traffic_profile(self, args) -> Any:
+        """Replayable workload profile — `cluster hot --profile`."""
+        from ..rpc.traffic import profile_response
+
+        return profile_response(self.garage)

@@ -158,6 +158,17 @@ def main(argv=None):
         "--once", action="store_true", help="render one frame and exit"
     )
     clu_sub.add_parser("telemetry", help="raw cluster rollup JSON")
+    chot = clu_sub.add_parser(
+        "hot", help="traffic observatory: hot objects/buckets, op mix, "
+        "slow peers (rpc/traffic.py)",
+    )
+    chot.add_argument(
+        "--profile", action="store_true",
+        help="print the replayable workload profile JSON instead",
+    )
+    chot.add_argument(
+        "--top", type=int, default=10, help="hot-object rows to show"
+    )
 
     ovl = sub.add_parser(
         "overload", help="overload-control plane: admission + shedding ladder"
@@ -453,7 +464,7 @@ def _render_cluster_top(r: dict) -> str:
         )
     out = format_table(head) + "\n\n"
     rows = [
-        "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry\tflags"
+        "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry\thot\tflags"
     ]
     for n in r.get("nodes", []):
         d = n.get("digest") or {}
@@ -482,6 +493,11 @@ def _render_cluster_top(r: dict) -> str:
             if cn.get("ops")
             else "-"
         )
+        # traffic observatory: the node's hottest bucket by (decayed)
+        # ops from the gossiped trf digest — skew is visible without
+        # touching the admin API
+        trf = d.get("trf") or {}
+        hot = str(trf.get("hb") or "-")[:14]
         rows.append(
             f"{n['id'][:16]}\t{n.get('hostname', '?')}\t"
             f"{'y' if n.get('isUp') else 'n'}\t{n.get('ageSecs', 0):.0f}s\t"
@@ -489,12 +505,72 @@ def _render_cluster_top(r: dict) -> str:
             f"{_ms(s3.get('p99'))}\t{_ms((d.get('loop') or {}).get('p99'))}\t"
             f"{(d.get('resync') or {}).get('q', 0)}\t"
             f"{(d.get('rpc') or {}).get('open', 0)}\t"
-            f"{cnry}\t"
+            f"{cnry}\t{hot}\t"
             f"{','.join(flags) or '-'}"
         )
     out += format_table(rows)
     for nid, reasons in sorted(outliers.items()):
         out += f"\n  outlier {nid[:16]}: " + "; ".join(reasons)
+    return out
+
+
+def _render_cluster_hot(r: dict, top: int = 10) -> str:
+    """`cluster hot`: the traffic observatory as an operator table —
+    hot objects, hot buckets, op mix, slow-peer piece-fetch ranking,
+    and the cluster-wide hottest bucket from the gossiped digests."""
+    local = r.get("local") or {}
+    head = [
+        f"observatory\t{'enabled' if r.get('enabled') else 'DISABLED'}",
+        f"ops seen\t{local.get('totalOps', 0)} "
+        f"(read fraction {local.get('readFraction')})",
+        f"keyspace skew\tzipf s = {local.get('zipfS')}",
+    ]
+    cluster = r.get("cluster") or {}
+    hb = cluster.get("hotBucket")
+    if hb:
+        head.append(
+            f"cluster hot bucket\t{hb['bucket']} "
+            f"(~{hb.get('ops', 0):g} decayed ops on {hb['node'][:16]})"
+        )
+    out = format_table(head) + "\n"
+    objs = (local.get("hotObjects") or [])[:top]
+    if objs:
+        rows = ["bucket/key\test ops\t±err\tshare"]
+        for o in objs:
+            rows.append(
+                f"{o['bucket']}/{o['key']}\t{o['count']:g}\t"
+                f"{o['errorBound']:g}\t{o['share'] * 100:.1f}%"
+            )
+        out += "\n== hot objects ==\n" + format_table(rows)
+    bkts = (local.get("hotBuckets") or [])[:top]
+    if bkts:
+        rows = ["bucket\test ops\tops/s\tshare"]
+        for b in bkts:
+            rows.append(
+                f"{b['bucket']}\t{b['count']:g}\t{b['opsPerSec']:g}\t"
+                f"{b['share'] * 100:.1f}%"
+            )
+        out += "\n\n== hot buckets ==\n" + format_table(rows)
+    mix = local.get("opMix") or {}
+    if any(mix.values()):
+        out += "\n\n== op mix ==\n" + format_table(
+            [
+                f"{op}\t{n}"
+                for op, n in sorted(mix.items(), key=lambda kv: -kv[1])
+                if n
+            ]
+        )
+    peers = r.get("slowPeers") or []
+    if peers:
+        rows = ["peer\tstate\tpiece lat\tfetches\tbytes ewma"]
+        for p in peers[:top]:
+            rows.append(
+                f"{p['peer'][:16]}\t"
+                f"{p['state']}{' SICK' if p.get('sick') else ''}\t"
+                f"{p['latMsecEwma'] if p['latMsecEwma'] is not None else '-'}"
+                f"ms\t{p['pieceFetches']}\t{p.get('bytesEwma') or '-'}"
+            )
+        out += "\n\n== slow peers (piece fetch) ==\n" + format_table(rows)
     return out
 
 
@@ -578,6 +654,15 @@ async def dispatch(args, call, config) -> str | None:
         return out
 
     if args.cmd == "cluster":
+        if args.cluster_cmd == "hot":
+            if args.profile:
+                return json.dumps(
+                    await call("traffic-profile"), indent=2, default=repr
+                )
+            r = await call("traffic")
+            if args.json:
+                return json.dumps(r, indent=2, default=repr)
+            return _render_cluster_hot(r, top=args.top)
         if args.cluster_cmd == "telemetry":
             return json.dumps(
                 await call("cluster-telemetry"), indent=2, default=repr

@@ -372,6 +372,8 @@ class Garage:
         self.watchdog = None
         # latency X-ray + canary prober (utils/latency.py, api/s3/canary.py)
         self._latency_enabled = False
+        # traffic observatory (rpc/traffic.py), enabled in start()
+        self._traffic_enabled = False
         self.canary = None
 
         # cluster telemetry plane (rpc/telemetry_digest.py): local digest
@@ -446,6 +448,17 @@ class Garage:
 
             latency.enable()
             self._latency_enabled = True
+        if adm.traffic_observatory:
+            # traffic observatory (rpc/traffic.py): refcounted singleton
+            # like the latency aggregator — the S3 request path records
+            # into it only while at least one node has it enabled
+            from ..rpc import traffic
+
+            traffic.enable(
+                topk=adm.traffic_topk,
+                halflife=adm.traffic_halflife_secs,
+            )
+            self._traffic_enabled = True
         self._register_gauges()
         # uptime measures SERVING time: restamp at start(), not object
         # construction (recovery work can run between the two)
@@ -647,6 +660,11 @@ class Garage:
 
             latency.disable()
             self._latency_enabled = False
+        if self._traffic_enabled:
+            from ..rpc import traffic
+
+            traffic.disable()
+            self._traffic_enabled = False
         await self.bg.shutdown()
         await self.block_manager.close()
         if self.canary is not None:

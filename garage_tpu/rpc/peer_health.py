@@ -59,6 +59,15 @@ class _Peer:
     transitions: int = 0
     successes: int = 0
     failures: int = 0
+    # EC read attribution (rpc/traffic.py): per-peer piece_fetch
+    # latency/bytes EWMAs feeding the slow-rank ranking item 1a's
+    # hedged reads will key off.  Separate from rtt_ewma on purpose:
+    # that one blends every RPC (pings, table ops); a slow DISK on a
+    # peer shows up here and nowhere else.
+    piece_fetches: int = 0
+    piece_bytes: float = 0.0
+    piece_lat_ewma: float | None = None
+    piece_bytes_ewma: float | None = None
 
 
 class PeerHealth:
@@ -209,6 +218,69 @@ class PeerHealth:
         elif p.state == CLOSED and p.consecutive_failures >= self.open_after:
             p.opened_at = self.clock()
             self._transition(node, p, OPEN)
+
+    def record_piece_fetch(
+        self, node: bytes, secs: float, nbytes: int
+    ) -> None:
+        """One successful remote EC piece fetch from `node` (fed by
+        block/manager.py `_fetch_piece`).  Failures don't land here —
+        they feed the breaker via record_failure; the ranking flags
+        sick/open peers ahead of any latency number anyway."""
+        if node == self.our_id:
+            return
+        p = self._peer(node)
+        a = self.ewma_alpha
+        p.piece_fetches += 1
+        p.piece_bytes += nbytes
+        p.piece_lat_ewma = (
+            secs
+            if p.piece_lat_ewma is None
+            else (1 - a) * p.piece_lat_ewma + a * secs
+        )
+        p.piece_bytes_ewma = (
+            float(nbytes)
+            if p.piece_bytes_ewma is None
+            else (1 - a) * p.piece_bytes_ewma + a * nbytes
+        )
+
+    def piece_fetch_ranking(self) -> list[dict]:
+        """Slowest-first per-peer read attribution: sick / breaker-open
+        peers rank ahead of everything (they are the slowest a read can
+        get), then by piece-fetch latency EWMA descending.  Peers with
+        neither signal are omitted."""
+        rows = []
+        for node, p in self.peers.items():
+            sick = self.is_sick(node)
+            if p.piece_fetches == 0 and not sick:
+                continue
+            rows.append(
+                {
+                    "peer": node.hex(),
+                    "state": p.state,
+                    "sick": sick,
+                    "pieceFetches": p.piece_fetches,
+                    "pieceBytes": int(p.piece_bytes),
+                    "latMsecEwma": (
+                        round(p.piece_lat_ewma * 1000, 3)
+                        if p.piece_lat_ewma is not None
+                        else None
+                    ),
+                    "bytesEwma": (
+                        round(p.piece_bytes_ewma, 1)
+                        if p.piece_bytes_ewma is not None
+                        else None
+                    ),
+                    "successEwma": round(p.success_ewma, 4),
+                }
+            )
+        rows.sort(
+            key=lambda r: (
+                0 if r["sick"] else 1,
+                -(r["latMsecEwma"] or 0.0),
+                r["peer"],
+            )
+        )
+        return rows
 
     # --- consumers -----------------------------------------------------------
 

@@ -97,9 +97,14 @@ class DigestCollector:
     # 4 s before a scrape-triggered collect would gossip rps=0)
     rate_window = 10.0
 
-    def __init__(self, garage, registry=None, clock=time.monotonic):
+    def __init__(self, garage, registry=None, clock=time.monotonic,
+                 observatory=None):
         self.garage = garage
         self.registry = registry if registry is not None else metrics_mod.registry
+        # traffic observatory (rpc/traffic.py): injectable for the same
+        # reason the registry is — the production singleton is process-
+        # wide, and in-process multi-node tests want per-node numbers
+        self.observatory = observatory
         self.clock = clock
         self.started_at = clock()
         self._prev: dict[str, float] | None = None
@@ -110,12 +115,23 @@ class DigestCollector:
 
     # --- counter snapshot ----------------------------------------------------
 
+    def _obs(self):
+        if self.observatory is not None:
+            return self.observatory
+        from .traffic import observatory
+
+        return observatory
+
     def _counters(self) -> dict[str, float]:
         r = self.registry
         return {
             "s3_req": r.counter_family_sum("api_s3_request_counter"),
             "s3_err": _s3_5xx_total(r),
             "tpu_disp": r.counter_family_sum("tpu_codec_dispatch_total"),
+            # traffic-observatory op total: rides the same windowed-rate
+            # machinery so the gossiped trf.rps can't drift from s3.rps
+            # methodology
+            "trf_ops": float(self._obs().total_ops),
         }
 
     def collect(self) -> dict[str, Any]:
@@ -205,6 +221,11 @@ class DigestCollector:
         slo = getattr(g, "slo_tracker", None)
         if slo is not None:
             digest["slo"] = slo.digest_fields()
+        # traffic observatory (rpc/traffic.py): op mix, hot bucket,
+        # keyspace skew — "trf" keys are additive, DIGEST_VERSION stays 1
+        digest["trf"] = self._obs().digest_fields(
+            rates.get("trf_ops", 0.0)
+        )
         # overload-control plane (api/overload.py + rpc/shedding.py):
         # ladder level + admission totals — a shedding node is visible
         # cluster-wide ("ovl" keys are additive, DIGEST_VERSION stays 1)
@@ -602,6 +623,22 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
      ("ovl", "shed")),
     ("cluster_node_in_flight_requests", "admitted requests in flight",
      ("ovl", "inf")),
+    # traffic observatory (rpc/traffic.py): numeric trf digest fields
+    # only — the hot bucket NAME stays in the JSON surfaces, never a
+    # label (metrics-lint cardinality guard)
+    ("cluster_node_traffic_ops_total",
+     "cumulative observatory-recorded S3 ops", ("trf", "ops")),
+    ("cluster_node_traffic_ops_per_second",
+     "observatory op rate", ("trf", "rps")),
+    ("cluster_node_traffic_read_fraction",
+     "read share of object traffic (GET+HEAD over all object ops)",
+     ("trf", "rdf")),
+    ("cluster_node_traffic_bytes_total",
+     "cumulative object payload bytes moved", ("trf", "by")),
+    ("cluster_node_traffic_hot_bucket_ops_per_second",
+     "approximate op rate of the node's hottest bucket", ("trf", "hbps")),
+    ("cluster_node_traffic_zipf_skew",
+     "estimated zipf exponent of the key popularity", ("trf", "zipf")),
 ]
 
 

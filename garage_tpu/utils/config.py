@@ -88,6 +88,15 @@ class AdminConfig:
     # must be a valid S3 bucket name; "hidden" because only the canary's
     # own key is authorized on it (ListBuckets is per-key)
     canary_bucket: str = "canary-probe"
+    # traffic observatory (rpc/traffic.py + utils/sketch.py): streaming
+    # hot-object / op-mix / skew analytics fed from the S3 request path,
+    # served from /v1/traffic (+ /v1/traffic/profile) — on by default,
+    # bounded memory (Space-Saving top-K + Count-Min).  The halflife is
+    # the exponential-decay window: "hot" means hot over roughly this
+    # many seconds, not since process start.
+    traffic_observatory: bool = True
+    traffic_topk: int = 256
+    traffic_halflife_secs: float = 600.0
 
 
 @dataclass
@@ -519,6 +528,12 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         raise ValueError("canary_object_bytes must be >= 1")
     if not str(cfg.admin.canary_bucket).strip():
         raise ValueError("canary_bucket must be a non-empty bucket name")
+    # traffic observatory: a tiny top-K can't rank anything, a zero/
+    # negative halflife breaks the decay math at the first sweep
+    if int(cfg.admin.traffic_topk) < 8:
+        raise ValueError("traffic_topk must be >= 8")
+    if float(cfg.admin.traffic_halflife_secs) <= 0:
+        raise ValueError("traffic_halflife_secs must be > 0")
     # overload knobs: refuse values that would wedge admission at load
     # time (a zero rate admits nothing forever; inverted hysteresis
     # thresholds would make the ladder oscillate by construction)

@@ -61,6 +61,23 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         ("detail.levels.64.ec_phases.overlap_efficiency", "<=", 0.95,
          "64-client EC PUT pipeline overlap (1.0 = sequential)"),
     ],
+    "BENCH_s3_readpath.json": [
+        # ISSUE 12: the committed BEFORE number for ROADMAP item 1's
+        # read-path attack — shape/presence floors only; the read-path
+        # PR adds the <= 2.0 ratio ceiling once it has a win to bank.
+        # (A `>=` floor on a required value doubles as a presence check:
+        # a deleted/reshaped artifact fails with missing-or-non-numeric.)
+        ("value", ">=", 0.1, "EC/replica GET p99 ratio banked"),
+        ("detail.ec_ms.get_p99", ">=", 0.1,
+         "EC GET p99 present (read-heavy zipfian)"),
+        ("detail.replica_ms.get_p99", ">=", 0.1,
+         "replica GET p99 present"),
+        ("detail.zipf_s", ">=", 0.5, "workload is actually zipfian"),
+        ("detail.observatory.topk_precision", ">=", 0.5,
+         "traffic observatory tracks the true hot set end-to-end"),
+        ("detail.observatory.read_fraction", ">=", 0.7,
+         "GET-dominant mix reached the observatory"),
+    ],
     "BENCH_repair_10k.json": [
         # measured 178.5 blocks/s on CPU loopback (PR 4); floor matches
         # tests/test_repair_plan.py's artifact floor

@@ -50,6 +50,22 @@ PROMQL_NOISE = {
 # suffixes the exposition adds to a histogram family
 HIST_SUFFIXES = ("_bucket", "_count", "_sum")
 
+# --- cardinality guard --------------------------------------------------------
+# Label names reserved for STATICALLY-bounded value sets: a `key` or
+# `bucket` label whose values track live objects/tenants is how
+# exposition cardinality explodes at millions of users.  Hot-key data is
+# served from the traffic observatory's sketch JSON endpoints
+# (`/v1/traffic`, rpc/traffic.py) ONLY — never as per-key Prometheus
+# series.  A family may carry one of these labels only by declaring the
+# complete value set here (histogram `le` is the exposition's own).
+GUARDED_LABELS = ("key", "bucket")
+BOUNDED_LABEL_VALUES: dict[str, dict[str, frozenset]] = {
+    # (none today: the admission plane's per-tenant gauges use the
+    # `tenant` label, which is LRU-bounded by config, not per-object)
+}
+
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
 
 def families_in_expr(expr: str) -> set[str]:
     """Metric families referenced by one PromQL expression."""
@@ -144,6 +160,18 @@ def lint_exposition(text: str) -> dict[str, str]:
         assert m, f"line {lineno} unparseable: {line!r}"
         name, labels = m.group(1), m.group(2) or ""
         float(m.group(3))
+        base = base_family(name)
+        for lname, lval in _LABEL_RE.findall(labels):
+            if lname not in GUARDED_LABELS:
+                continue
+            allowed = BOUNDED_LABEL_VALUES.get(base, {}).get(lname)
+            assert allowed is not None and lval in allowed, (
+                f"family {base} carries a {lname!r} label "
+                f"(value {lval!r}) without a declared static value set "
+                "— per-object label cardinality is forbidden; serve "
+                "hot-key data from the /v1/traffic sketch endpoints "
+                "(see BOUNDED_LABEL_VALUES in script/dashboard_lint.py)"
+            )
         key = (name, labels)
         assert key not in seen, f"duplicate sample {key}"
         seen.add(key)

@@ -102,3 +102,37 @@ def test_dashboard_lint_against_live_node(tmp_path):
             await teardown(garage, s3)
 
     run(main())
+
+
+def test_cardinality_guard_rejects_per_object_labels():
+    """Satellite (ISSUE 12): no live exposition family may carry a
+    `key` or `bucket` label without a statically-declared value set —
+    hot-key data is served from the /v1/traffic sketch endpoints only,
+    never as per-key Prometheus series."""
+    import pytest
+
+    from dashboard_lint import lint_exposition
+
+    bad_key = (
+        "# TYPE api_leak_total counter\n"
+        'api_leak_total{key="tenant-object-17"} 3\n'
+    )
+    with pytest.raises(AssertionError, match="key"):
+        lint_exposition(bad_key)
+    bad_bucket = (
+        "# TYPE api_leak_total counter\n"
+        'api_leak_total{bucket="customer-data"} 3\n'
+    )
+    with pytest.raises(AssertionError, match="bucket"):
+        lint_exposition(bad_bucket)
+    # histogram `le` and other label names stay fine, and the renamed
+    # per-tenant admission gauges pass
+    ok = (
+        "# TYPE api_admission_key_tokens gauge\n"
+        'api_admission_key_tokens{tenant="GK123",id="n0"} 9\n'
+        "# TYPE api_s3_request_duration histogram\n"
+        'api_s3_request_duration_bucket{le="+Inf"} 1\n'
+        "api_s3_request_duration_count 1\n"
+        "api_s3_request_duration_sum 0.1\n"
+    )
+    assert "api_admission_key_tokens" in lint_exposition(ok)

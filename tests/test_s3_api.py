@@ -788,8 +788,16 @@ def test_presigned_query_validation():
         await check(q(expires="-5"), "X-Amz-Expires")
         bad_scope = (now - timedelta(days=3)).strftime("%Y%m%d")
         await check(q(scope_date=bad_scope), "scope date")
-        future = (now + timedelta(hours=2)).strftime("%Y%m%dT%H%M%SZ")
-        await check(q(timestamp=future), "future")
+        # scope date must track the future timestamp, or a run within
+        # 2 h of UTC midnight fails the scope-date check first
+        future_dt = now + timedelta(hours=2)
+        await check(
+            q(
+                timestamp=future_dt.strftime("%Y%m%dT%H%M%SZ"),
+                scope_date=future_dt.strftime("%Y%m%d"),
+            ),
+            "future",
+        )
         # a well-formed query gets past validation to the signature check
         await check(q(), "signature does not match")
 

@@ -1,0 +1,166 @@
+"""Satellite (ISSUE 12): utils/sketch.py — Space-Saving error bounds on
+a synthetic zipfian stream, decay-window behavior, merge() associativity,
+and the hard memory bound (tracked-item count never exceeds capacity
+regardless of stream length)."""
+
+import random
+from collections import Counter
+
+from garage_tpu.utils.sketch import CountMin, SpaceSaving, zipf_exponent
+
+
+def _zipf_stream(n_keys=1000, n=50_000, s=1.2, seed=7):
+    rng = random.Random(seed)
+    weights = [1.0 / (i + 1) ** s for i in range(n_keys)]
+    stream = [f"k{i}" for i in rng.choices(range(n_keys), weights, k=n)]
+    return stream, Counter(stream)
+
+
+def test_space_saving_error_bounds_on_zipfian_stream():
+    stream, true = _zipf_stream()
+    ss = SpaceSaving(64)
+    for k in stream:
+        ss.incr(k)
+    # the classic guarantee: every tracked key's true count lies in
+    # [count - error, count], and error <= total / capacity
+    for k, count, err in ss.top():
+        assert count - err <= true[k] <= count + 1e-9, (k, count, err)
+        assert err <= ss.total / ss.capacity + 1e-9
+    # heavy hitters are all tracked (true weight > total/capacity
+    # guarantees presence; the zipfian head easily clears that)
+    got = {k for k, _c, _e in ss.top(10)}
+    want = {k for k, _ in true.most_common(10)}
+    assert len(got & want) >= 8, (got, want)
+    # untracked keys estimate at the min count (an upper bound)
+    assert ss.estimate("never-seen") == ss.min_count()
+
+
+def test_space_saving_hard_memory_bound():
+    ss = SpaceSaving(32)
+    # 10k DISTINCT keys — worst case for the eviction path
+    for i in range(10_000):
+        ss.incr(f"distinct-{i}")
+        assert len(ss) <= 32
+        assert len(ss._heap) <= 4 * 32 + 64 + 1  # lazy-heap bound
+    assert ss.total == 10_000
+
+
+def test_space_saving_decay_window():
+    t = [0.0]
+    ss = SpaceSaving(16, halflife=10.0, clock=lambda: t[0])
+    for _ in range(1000):
+        ss.incr("old-hot")
+    # two halflives later the old key has decayed 4x; fresh traffic on
+    # a new key overtakes it
+    t[0] = 20.0
+    for _ in range(400):
+        ss.incr("new-hot")
+    top = ss.top(2)
+    assert top[0][0] == "new-hot", top
+    old = dict((k, c) for k, c, _e in top)["old-hot"]
+    assert 200 <= old <= 300  # ~1000 * 0.25, modulo sweep granularity
+    assert ss.total < 1000 + 400  # the total decays too
+    # read-only accessors apply the decay too: estimate() after a long
+    # quiet period must match top()'s scale, not the undecayed counts
+    t[0] = 120.0
+    est = ss.estimate("new-hot")
+    assert est < 1.0, est
+    assert abs(est - dict((k, c) for k, c, _e in ss.top())["new-hot"]) < 1e-9
+
+
+def test_space_saving_merge_associative_within_capacity():
+    def mk(pairs):
+        s = SpaceSaving(32)
+        for k, n in pairs:
+            s.incr(k, n)
+        return s
+
+    a = mk([(f"x{i}", i + 1) for i in range(10)])
+    b = mk([(f"x{i}", 2 * i + 1) for i in range(5)] + [("y0", 7)])
+    c = mk([(f"z{i}", i + 2) for i in range(8)])
+    m1 = a.merge(b).merge(c)
+    m2 = a.merge(b.merge(c))
+    assert m1.counts == m2.counts
+    assert m1.errors == m2.errors
+    assert m1.total == m2.total
+    # and the merge is exact here (no truncation): x0 = 1 + 1
+    assert m1.counts["x0"] == 2 and m1.counts["y0"] == 7
+
+
+def test_space_saving_merge_bounds_beyond_capacity():
+    """Truncating merges keep the upper/lower-bound guarantee vs the
+    combined true stream."""
+    s1, t1 = _zipf_stream(seed=1)
+    s2, t2 = _zipf_stream(seed=2)
+    a, b = SpaceSaving(64), SpaceSaving(64)
+    for k in s1:
+        a.incr(k)
+    for k in s2:
+        b.incr(k)
+    m = a.merge(b)
+    true = t1 + t2
+    assert len(m) <= 64
+    for k, count, err in m.top():
+        assert count + 1e-9 >= true[k], (k, count, true[k])
+        assert count - err <= true[k] + 1e-9, (k, count, err, true[k])
+    got = {k for k, _c, _e in m.top(5)}
+    want = {k for k, _ in true.most_common(5)}
+    assert len(got & want) >= 4
+    # geometry mismatch is refused (a smaller-capacity side's min_count
+    # would understate the untracked-key bound)
+    try:
+        a.merge(SpaceSaving(8))
+        raise AssertionError("mismatched-capacity merge must raise")
+    except ValueError:
+        pass
+
+
+def test_count_min_estimates_and_merge():
+    stream, true = _zipf_stream(n=20_000)
+    cm = CountMin(width=1024, depth=4)
+    for k in stream:
+        cm.incr(k)
+    # estimates are upper bounds, with the classic additive error
+    for k, n in true.most_common(20):
+        est = cm.estimate(k)
+        assert est + 1e-9 >= n
+        assert est - n <= 4 * cm.total / cm.width  # loose w.h.p. bound
+    # merge is pointwise: estimates add
+    other = CountMin(width=1024, depth=4)
+    for _ in range(50):
+        other.incr("k0")
+    m = cm.merge(other)
+    assert abs(m.estimate("k0") - (cm.estimate("k0") + 50)) < 1e-9
+    assert m.total == cm.total + other.total
+    # geometry mismatch is refused, not silently wrong
+    try:
+        cm.merge(CountMin(width=512, depth=4))
+        raise AssertionError("mismatched merge must raise")
+    except ValueError:
+        pass
+
+
+def test_count_min_decay():
+    t = [0.0]
+    cm = CountMin(width=256, depth=3, halflife=10.0, clock=lambda: t[0])
+    for _ in range(800):
+        cm.incr("hot")
+    t[0] = 10.0
+    cm.incr("hot")  # triggers the lazy sweep
+    assert 380 <= cm.estimate("hot") <= 480  # ~800 * 0.5 + 1
+    # a READ after further quiet time decays too — estimate() must not
+    # return stale undecayed cells
+    t[0] = 30.0
+    assert cm.estimate("hot") < 150
+
+
+def test_zipf_exponent_fit():
+    # a perfect zipf(1.0) rank-count curve fits s ~ 1.0
+    counts = [1000.0 / (r + 1) for r in range(20)]
+    s = zipf_exponent(counts)
+    assert 0.9 <= s <= 1.1, s
+    # uniform counts fit ~0
+    assert zipf_exponent([50.0] * 20) == 0.0
+    # not enough points: no estimate, never a crash
+    assert zipf_exponent([5.0, 3.0]) is None
+    assert zipf_exponent([]) is None
