@@ -185,6 +185,33 @@ async def run_read_heavy_cluster(
 
     from garage_tpu.rpc import traffic as traffic_mod
     from garage_tpu.utils import latency as latency_mod
+    from garage_tpu.utils.metrics import registry
+
+    def _read_path_counts() -> dict:
+        """Cumulative read-pipeline counters (ISSUE 13): sampled before/
+        after the measured mix, the delta shows what served the GETs —
+        cache hits vs systematic streams vs reconstruction decodes, and
+        how often hedges fired."""
+
+        def _c(name, labels=()):
+            return registry.counters.get((name, labels), 0)
+
+        return {
+            "cache_hits": _c("block_cache_hits_total"),
+            "cache_misses": _c("block_cache_misses_total"),
+            "decode_systematic": _c(
+                "block_codec_blocks_total",
+                (("op", "decode"), ("path", "systematic")),
+            ),
+            "decode_reconstruct": _c(
+                "block_codec_blocks_total",
+                (("op", "decode"), ("path", "reconstruct")),
+            ),
+            "hedges": {
+                oc: _c("block_read_hedges_total", (("outcome", oc),))
+                for oc in ("won", "lost", "failed")
+            },
+        }
 
     garages, s3, client = await boot_bench_cluster(
         tmp_path, mode, n=n_nodes, block_size=block_size
@@ -217,6 +244,7 @@ async def run_read_heavy_cluster(
 
         latency_mod.aggregator.reset()
         traffic_mod.observatory.reset()
+        rp0 = _read_path_counts()
         get_times: list[float] = []
         put_times: list[float] = []
 
@@ -234,6 +262,18 @@ async def run_read_heavy_cluster(
         await asyncio.gather(*[worker(w) for w in range(concurrency)])
         await asyncio.sleep(0.05)  # trailing in-process records land
 
+        rp1 = _read_path_counts()
+        read_path = {
+            k: rp1[k] - rp0[k]
+            for k in (
+                "cache_hits", "cache_misses",
+                "decode_systematic", "decode_reconstruct",
+            )
+        }
+        read_path["hedges"] = {
+            oc: rp1["hedges"][oc] - rp0["hedges"][oc]
+            for oc in rp1["hedges"]
+        }
         snap = traffic_mod.observatory.snapshot()
         got = [
             o["key"] for o in snap["hotObjects"]
@@ -244,6 +284,7 @@ async def run_read_heavy_cluster(
             "get_p50": _pct(get_times, 0.5),
             "get_p99": _pct(get_times, 0.99),
             "put_p99": _pct(put_times, 0.99) if put_times else None,
+            "read_path": read_path,
             "phases": _phase_summary(
                 latency_mod.aggregator.snapshot().get("get")
             ),
@@ -450,6 +491,12 @@ async def main() -> None:
                 "read_fraction": 0.9,
                 "replica_ms": _rms(rep),
                 "ec_ms": _rms(ec),
+                # what served the GETs (ISSUE 13): cache hits vs
+                # systematic streams vs reconstruction, + hedge outcomes
+                "read_path": {
+                    "replica": rep["read_path"],
+                    "ec": ec["read_path"],
+                },
                 "phases": {"replica": rep["phases"], "ec": ec["phases"]},
                 # what the observatory reported for the EC run — the
                 # precision datum doubles as an end-to-end check that

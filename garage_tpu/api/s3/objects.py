@@ -539,7 +539,7 @@ async def plain_block_stream(garage, blocks, start: int, end: int, enc_params):
 
     bm = garage.block_manager
     tag_stream = new_order_stream()
-    tasks: list[asyncio.Task] = []
+    reads: list = []
     nxt = 0
     try:
         for i, (b_start, b_end, _h) in enumerate(wanted):
@@ -549,43 +549,50 @@ async def plain_block_stream(garage, blocks, start: int, end: int, enc_params):
                 # (api/overload.py), and its piece fetches must outrank
                 # PUT fan-out (PRIO_NORMAL) and background resync
                 # (PRIO_BACKGROUND) at the connection scheduler too —
-                # the RPC-level mirror of the HTTP priority classes
-                tasks.append(
-                    asyncio.create_task(
-                        bm.rpc_get_block(
-                            wanted[nxt][2], prio=PRIO_HIGH,
-                            order_tag=tag_stream.order(),
-                        )
+                # the RPC-level mirror of the HTTP priority classes.
+                # start_block_read begins fetching NOW: block i's
+                # systematic pieces stream out below while blocks
+                # i+1..i+depth gather theirs (ISSUE 13).
+                reads.append(
+                    bm.start_block_read(
+                        wanted[nxt][2], prio=PRIO_HIGH,
+                        order_tag=tag_stream.order(),
                     )
                 )
                 nxt += 1
-            data = await tasks[i]
-            tasks[i] = None  # drop the result: window RAM stays bounded
-            if enc_params is not None:
-                data = enc_params.decrypt_block(data)
+            br = reads[i]
             lo = max(start - b_start, 0)
             hi = min(end, b_end) - b_start
-            yield data[lo:hi]
-            del data
+            if enc_params is not None:
+                # SSE blocks only decrypt whole: assemble, then slice
+                data = enc_params.decrypt_block(await br.bytes())
+                yield data[lo:hi]
+                del data
+            else:
+                # stream chunks as the block's pieces land, clipped to
+                # the requested [lo, hi) plaintext window
+                pos = 0
+                async for chunk in br.chunks():
+                    c = chunk[max(lo - pos, 0): max(hi - pos, 0)]
+                    pos += len(chunk)
+                    if c:
+                        yield c  # consumer records stream_out
+                    del chunk
+            reads[i] = None  # drop the handle: window RAM stays bounded
     finally:
         # consumer gone (disconnect) or error: abort every in-flight
-        # prefetch, including the one currently awaited
-        live = [t for t in tasks if t is not None]
-        pending = [t for t in live if not t.done()]
-        for t in pending:
-            t.cancel()
+        # prefetch, including the one currently consumed
+        live = [r for r in reads if r is not None]
 
-        async def _drain_and_sweep(cancelled, started):
-            if cancelled:
-                await asyncio.gather(*cancelled, return_exceptions=True)
-            for t in started:  # silence never-retrieved warnings
-                if t.done() and not t.cancelled():
-                    t.exception()
+        async def _abort_reads(rs):
+            # concurrent: teardown costs the slowest cancel, not the sum
+            await asyncio.gather(*[r.abort() for r in rs])
 
-        # ONE shielded coroutine for drain + sweep: a cancel landing
-        # mid-drain re-raises at this await but the sweep still runs to
-        # completion in the shielded task (graft-lint cancel-safety)
-        await asyncio.shield(_drain_and_sweep(pending, live))
+        # ONE shielded coroutine for the aborts: a cancel landing
+        # mid-drain re-raises at this await but every pump is still
+        # reaped in the shielded task (graft-lint cancel-safety)
+        if live:
+            await asyncio.shield(_abort_reads(live))
 
 
 def _parse_part_number(request) -> int | None:

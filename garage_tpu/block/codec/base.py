@@ -46,6 +46,14 @@ class BlockCodec:
         """[(pieces, want, block_len)] -> [reconstructed pieces]."""
         return [self.reconstruct_pieces(p, w, n) for p, w, n in batches]
 
+    def decode_batch(
+        self, items: list[tuple[dict[int, bytes], int]], impl: str = "auto"
+    ) -> list[bytes]:
+        """[(pieces, block_len)] -> [plaintext blocks] — the codec
+        batcher's decode-lane backend (block/codec_batch.py); default
+        falls back to the scalar decode."""
+        return [self.decode(p, n) for p, n in items]
+
     def piece_len(self, block_len: int) -> int:
         raise NotImplementedError
 

@@ -1,20 +1,31 @@
-"""CodecBatcher: cross-request coalescing of foreground EC encodes.
+"""CodecBatcher: cross-request coalescing of foreground EC codec work.
 
 ROADMAP item 1: the EC PUT path used to call `codec.encode(data)`
 synchronously per block, so N concurrent PUT requests serialized N
 single-block codec dispatches on the event loop — the batched offload
 the BASELINE.json north star is about never reached the foreground
 write path (only the PR 4 repair plane batched).  This module closes
-that gap with a dynamic batcher in front of the codec:
+that gap with a dynamic batcher in front of the codec, organized as two
+LANES sharing one set of knobs:
 
-  - concurrent `encode()` calls queue their blocks and share ONE
-    coalesced dispatch (`EcCodec.encode_batch_hashed`: fused
-    encode+BLAKE3 on device backends with power-of-two batch buckets
-    and donated inputs, native C codec + batched native BLAKE3 on the
-    host backend);
+  - the **encode lane** (PR 9): concurrent `encode()` calls queue their
+    blocks and share ONE coalesced dispatch (`EcCodec.encode_batch_hashed`:
+    fused encode+BLAKE3 on device backends with power-of-two batch
+    buckets and donated inputs, native C codec + batched native BLAKE3
+    on the host backend);
+
+  - the **decode lane** (ISSUE 13): degraded-mode GETs — a data shard
+    missing, a real reconstruction needed — queue their gathered pieces
+    and share one grouped reconstruction dispatch
+    (`EcCodec.decode_batch`), so a burst of reads against a degraded
+    stripe set coalesces instead of serializing N single-block matrix
+    solves.  Healthy-cluster GETs never come here: the systematic
+    streaming fast path (block/manager.py) needs zero decode.
+
+Shared behavior per lane:
 
   - a lone request flushes after a bounded linger (`linger_msec`,
-    default 2 ms — noise against the EC PUT's quorum round-trips, so
+    default 2 ms — noise against the EC quorum round-trips, so
     single-client latency never regresses), while a full batch
     (`max_blocks` / `max_bytes`) flushes immediately;
 
@@ -27,23 +38,25 @@ that gap with a dynamic batcher in front of the codec:
     dispatches whose batch never flowed through `ops/bucketing.py` —
     see doc/static-analysis.md;
 
-  - a dispatch error fails only that batch's waiters; a cancelled PUT
-    abandons its entry without poisoning the other requests coalesced
-    into the same dispatch.
+  - a dispatch error fails only that batch's waiters; a cancelled
+    request abandons its entry without poisoning the other requests
+    coalesced into the same dispatch.
 
 Phase attribution (utils/latency.py): the submitting request records
 `codec_batch_wait` (queue time until its dispatch starts) separately
-from `encode` (the dispatch itself), so the X-ray waterfall shows
-whether latency went to coalescing or to the codec.
+from `encode`/`decode` (the dispatch itself), so the X-ray waterfall
+shows whether latency went to coalescing or to the codec.
 
 Metric families (doc/monitoring.md):
 
-  block_codec_batch_size          blocks per coalesced dispatch (H)
-  block_codec_batch_dispatch_total{flush}  dispatches by flush reason
-                                  (full | linger | drain)
+  block_codec_batch_size          blocks per coalesced encode dispatch (H)
+  block_codec_batch_dispatch_total{flush}  encode dispatches by flush
+                                  reason (full | linger)
+  block_codec_batch_decode_dispatch_total{flush}  decode-lane dispatches
   block_codec_batch_coalesced_total  blocks that shared a dispatch
                                   with at least one other block
-  block_codec_batch_queue_depth{id}  blocks waiting in the batcher (G)
+  block_codec_batch_queue_depth{id}  blocks waiting in a lane (G; one
+                                  instance per lane)
 """
 
 from __future__ import annotations
@@ -68,73 +81,74 @@ _gauge_ids = itertools.count(1)
 
 
 class _Entry:
-    __slots__ = ("data", "arrived", "started", "fut")
+    __slots__ = ("payload", "nbytes", "arrived", "started", "fut")
 
-    def __init__(self, data: bytes):
-        self.data = data
+    def __init__(self, payload, nbytes: int):
+        self.payload = payload
+        self.nbytes = nbytes
         self.arrived = time.monotonic()
         # set when this entry's dispatch begins (ends codec_batch_wait)
         self.started = asyncio.Event()
         self.fut: asyncio.Future = asyncio.get_running_loop().create_future()
 
 
-class CodecBatcher:
-    """Short-linger queue coalescing concurrent block encodes into
-    mesh-sized codec dispatches.  One instance per BlockManager (per
-    node); the flusher task spawns lazily on first use and is reaped by
-    `close()`."""
+class _Lane:
+    """One coalescing queue (encode or decode) reading the batcher's
+    live knobs on every flush.  `dispatch_fn(payloads, impl)` is the
+    SYNC codec entry point, run via asyncio.to_thread; `phase` is the
+    latency-X-ray phase the post-wait dispatch time lands in."""
 
-    def __init__(
-        self,
-        codec,
-        *,
-        linger_msec: float = 2.0,
-        max_blocks: int = 64,
-        max_bytes: int = 64 * 1024 * 1024,
-        impl: str = "auto",
-    ):
-        self.codec = codec
-        # live-tunable (BgVars `codec-batch-*`): read on every flush
-        self.linger_msec = float(linger_msec)
-        self.max_blocks = int(max_blocks)
-        self.max_bytes = int(max_bytes)
-        self.impl = impl
-        self._pending: list[_Entry] = []
-        self._pending_bytes = 0
-        self._wake = asyncio.Event()
-        self._task: asyncio.Task | None = None
-        self._closed = False
-        self._gauge_key = (
+    def __init__(self, batcher: "CodecBatcher", name: str, phase: str,
+                 dispatch_fn, size_metrics: bool):
+        self.batcher = batcher
+        self.name = name
+        self.phase = phase
+        self.dispatch_fn = dispatch_fn
+        # encode keeps the PR 9 family names; decode gets its own
+        # dispatch counter so coalescing tests/panels can tell the lanes
+        # apart.  Size/coalesced histograms stay encode-only (the doc'd
+        # families) — the decode volume split already lives in
+        # `block_codec_blocks_total{op="decode",...}`.
+        self.size_metrics = size_metrics
+        self.dispatch_counter = (
+            "block_codec_batch_dispatch_total"
+            if name == "encode"
+            else f"block_codec_batch_{name}_dispatch_total"
+        )
+        self.pending: list[_Entry] = []
+        self.pending_bytes = 0
+        self.wake = asyncio.Event()
+        self.task: asyncio.Task | None = None
+        self.gauge_key = (
             "block_codec_batch_queue_depth",
             (("id", str(next(_gauge_ids))),),
         )
         registry.register_gauge(
-            *self._gauge_key, lambda: float(len(self._pending))
+            *self.gauge_key, lambda: float(len(self.pending))
         )
 
     # --- submit side ----------------------------------------------------------
 
-    async def encode(self, data: bytes) -> tuple[list[bytes], list[bytes] | None]:
-        """Queue one block; returns (pieces, piece_hashes | None) once
-        its coalesced dispatch completes.  Runs in the caller's task, so
-        the phase spans land on the caller's trace."""
-        if self._closed:
+    async def submit(self, payload, nbytes: int):
+        if self.batcher._closed:
             raise Error("codec batcher is closed")
-        entry = _Entry(data)
-        self._pending.append(entry)
-        self._pending_bytes += len(data)
-        self._wake.set()
-        if self._task is None:
-            self._task = spawn_supervised(self._run(), name="codec-batcher")
+        entry = _Entry(payload, nbytes)
+        self.pending.append(entry)
+        self.pending_bytes += nbytes
+        self.wake.set()
+        if self.task is None:
+            self.task = spawn_supervised(
+                self._run(), name=f"codec-batcher-{self.name}"
+            )
         try:
             with phase_span("codec_batch_wait"):
                 await entry.started.wait()
-            with phase_span("encode"):
+            with phase_span(self.phase):
                 return await entry.fut
         except asyncio.CancelledError:
-            # a PUT cancelled mid-batch abandons its slot; the dispatch
-            # (if already in flight) completes for the OTHER waiters,
-            # and `_take`/`_dispatch` skip the cancelled future
+            # a request cancelled mid-batch abandons its slot; the
+            # dispatch (if already in flight) completes for the OTHER
+            # waiters, and `_take`/`_dispatch` skip the cancelled future
             entry.fut.cancel()
             raise
 
@@ -142,28 +156,30 @@ class CodecBatcher:
 
     def _batch_full(self) -> bool:
         return (
-            len(self._pending) >= self.max_blocks
-            or self._pending_bytes >= self.max_bytes
+            len(self.pending) >= self.batcher.max_blocks
+            or self.pending_bytes >= self.batcher.max_bytes
         )
 
     async def _run(self) -> None:
-        while not self._closed:
-            if not self._pending:
-                self._wake.clear()
-                # re-check: an encode() may have queued between the
+        while not self.batcher._closed:
+            if not self.pending:
+                self.wake.clear()
+                # re-check: a submit() may have queued between the
                 # pending check and the clear
-                if not self._pending:
-                    await self._wake.wait()
+                if not self.pending:
+                    await self.wake.wait()
                 continue
             flush = "full"
             if not self._batch_full():
                 # linger anchored at the HEAD entry's arrival: entries
                 # that queued while a previous dispatch was running have
                 # already waited their window and flush immediately
-                deadline = self._pending[0].arrived + self.linger_msec / 1e3
+                deadline = (
+                    self.pending[0].arrived + self.batcher.linger_msec / 1e3
+                )
                 flush = "linger"
                 while True:
-                    self._wake.clear()
+                    self.wake.clear()
                     if self._batch_full():  # re-check after the clear
                         flush = "full"
                         break
@@ -171,7 +187,7 @@ class CodecBatcher:
                     if remaining <= 0:
                         break
                     try:
-                        await asyncio.wait_for(self._wake.wait(), remaining)
+                        await asyncio.wait_for(self.wake.wait(), remaining)
                     except asyncio.TimeoutError:
                         break
             await self._dispatch(self._take(), flush)
@@ -181,16 +197,16 @@ class CodecBatcher:
         waiters are dropped here, before they cost a dispatch slot)."""
         batch: list[_Entry] = []
         size = 0
-        while self._pending and len(batch) < self.max_blocks:
-            if batch and size + len(self._pending[0].data) > self.max_bytes:
+        while self.pending and len(batch) < self.batcher.max_blocks:
+            if batch and size + self.pending[0].nbytes > self.batcher.max_bytes:
                 break
-            e = self._pending.pop(0)
-            self._pending_bytes -= len(e.data)
+            e = self.pending.pop(0)
+            self.pending_bytes -= e.nbytes
             if e.fut.cancelled():
                 e.started.set()
                 continue
             batch.append(e)
-            size += len(e.data)
+            size += e.nbytes
         return batch
 
     async def _dispatch(self, batch: list[_Entry], flush: str) -> None:
@@ -198,18 +214,21 @@ class CodecBatcher:
             return
         for e in batch:
             e.started.set()
-        registry.observe("block_codec_batch_size", (), float(len(batch)))
-        registry.incr("block_codec_batch_dispatch_total", (("flush", flush),))
-        if len(batch) > 1:
+        if self.size_metrics:
+            registry.observe(
+                "block_codec_batch_size", (), float(len(batch))
+            )
+        registry.incr(self.dispatch_counter, (("flush", flush),))
+        if len(batch) > 1 and self.size_metrics:
             registry.incr("block_codec_batch_coalesced_total", by=len(batch))
         try:
-            # the sync batch encode is handed to a worker thread — the
+            # the sync batch dispatch is handed to a worker thread — the
             # loop keeps serving other requests' fan-outs while the
             # codec math runs (graft-lint passed-not-called remedy)
             results = await asyncio.to_thread(
-                self.codec.encode_batch_hashed,
-                [e.data for e in batch],
-                self.impl,
+                self.dispatch_fn,
+                [e.payload for e in batch],
+                self.batcher.impl,
             )
         except Exception as e:  # noqa: BLE001 — fails THIS batch's waiters
             for ent in batch:
@@ -220,7 +239,7 @@ class CodecBatcher:
             return
         except BaseException:
             # flusher cancelled mid-dispatch (close() during node stop):
-            # this batch was already drained out of _pending, so close()
+            # this batch was already drained out of `pending`, so close()
             # can't fail its futures — do it here or every waiter of the
             # in-flight batch hangs forever on `await entry.fut`
             for ent in batch:
@@ -234,20 +253,78 @@ class CodecBatcher:
                 ent.fut.set_result(res)
 
     async def close(self) -> None:
-        """Fail pending waiters, reap the flusher, drop the gauge (the
-        PR 8 resource rule: registered at creation, unregistered at
-        close)."""
-        self._closed = True
-        self._wake.set()
-        for e in self._pending:
+        for e in self.pending:
             e.started.set()
             if not e.fut.done():
                 e.fut.set_exception(Error("codec batcher is closed"))
-        self._pending.clear()
-        self._pending_bytes = 0
-        if self._task is not None:
+        self.pending.clear()
+        self.pending_bytes = 0
+        if self.task is not None:
             from ..utils.aio import reap
 
-            await reap([self._task], log=logger, what="codec-batcher flusher")
-            self._task = None
-        registry.unregister_gauge(*self._gauge_key)
+            await reap(
+                [self.task], log=logger,
+                what=f"codec-batcher {self.name} flusher",
+            )
+            self.task = None
+        registry.unregister_gauge(*self.gauge_key)
+
+
+class CodecBatcher:
+    """Short-linger queues coalescing concurrent block encodes (and
+    degraded-read decodes) into mesh-sized codec dispatches.  One
+    instance per BlockManager (per node); each lane's flusher task
+    spawns lazily on first use and is reaped by `close()`."""
+
+    def __init__(
+        self,
+        codec,
+        *,
+        linger_msec: float = 2.0,
+        max_blocks: int = 64,
+        max_bytes: int = 64 * 1024 * 1024,
+        impl: str = "auto",
+    ):
+        self.codec = codec
+        # live-tunable (BgVars `codec-batch-*`): read on every flush,
+        # shared by both lanes
+        self.linger_msec = float(linger_msec)
+        self.max_blocks = int(max_blocks)
+        self.max_bytes = int(max_bytes)
+        self.impl = impl
+        self._closed = False
+        self._encode = _Lane(
+            self, "encode", "encode", codec.encode_batch_hashed,
+            size_metrics=True,
+        )
+        # late-bound so a codec without decode_batch (stub codecs in
+        # tests) still constructs; a decode() against one fails only
+        # that call's batch
+        self._decode = _Lane(
+            self, "decode", "decode",
+            lambda items, impl: self.codec.decode_batch(items, impl),
+            size_metrics=False,
+        )
+
+    async def encode(self, data: bytes) -> tuple[list[bytes], list[bytes] | None]:
+        """Queue one block; returns (pieces, piece_hashes | None) once
+        its coalesced dispatch completes.  Runs in the caller's task, so
+        the phase spans land on the caller's trace."""
+        return await self._encode.submit(data, len(data))
+
+    async def decode(self, pieces: dict[int, bytes], block_len: int) -> bytes:
+        """Queue one degraded-read reconstruction; returns the plaintext
+        block once its coalesced `decode_batch` dispatch completes."""
+        return await self._decode.submit(
+            (pieces, block_len), sum(len(p) for p in pieces.values())
+        )
+
+    async def close(self) -> None:
+        """Fail pending waiters, reap the flushers, drop the gauges (the
+        PR 8 resource rule: registered at creation, unregistered at
+        close)."""
+        self._closed = True
+        self._encode.wake.set()
+        self._decode.wake.set()
+        await self._encode.close()
+        await self._decode.close()

@@ -29,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from typing import Any
 
 import zstandard
@@ -250,6 +251,12 @@ class BlockManager:
                 max_bytes=self.block_config.batch_max_bytes,
                 impl=self.block_config.batch_impl,
             )
+        # hot-block read cache (ISSUE 13): per-NODE on purpose — a
+        # process-wide singleton would let in-process test-cluster node A
+        # "read" a block it never fetched (the PR 6/9 singleton hazard)
+        from .read_cache import BlockCache
+
+        self.read_cache = BlockCache(self.block_config.read_cache_bytes)
         # seedable disk-fault seam (net/fault.py FaultPlan): when set,
         # local block reads/writes may fail per the plan's probabilities
         self.fault_plan = None
@@ -524,9 +531,11 @@ class BlockManager:
 
     async def close(self) -> None:
         """Tear down foreground resources (Garage.stop): the codec
-        batcher's flusher task and its queue-depth gauge."""
+        batcher's flusher tasks + queue-depth gauges and the read
+        cache's bytes gauge."""
         if self.batcher is not None:
             await self.batcher.close()
+        self.read_cache.close()
 
     async def _encode_ec(
         self, data: bytes
@@ -752,62 +761,220 @@ class BlockManager:
     async def rpc_get_block(
         self, hash32: bytes, prio: int = PRIO_NORMAL, order_tag=None
     ) -> bytes:
-        """Fetch a block: local first, then peers in latency order with
-        fallback (reference manager.rs:243-344).  EC mode gathers k pieces
-        (data-piece fast path, any-k + decode on failure).  `order_tag`
-        serializes this fetch within a multi-block GET pipeline so
-        responses stream back-to-back (reference net/message.rs:62-89)."""
+        """Fetch a block — hedged and (EC) systematic-streamed
+        internally (reference manager.rs:243-344 local-then-peers, plus
+        the ISSUE 13 read pipeline); replica mode reads local disk, then
+        the cache, then peers; EC reads the cache, then gathers pieces.
+        This form assembles the whole block.  `order_tag` serializes the fetch
+        within a multi-block GET pipeline so responses stream
+        back-to-back (reference net/message.rs:62-89)."""
         from ..utils.metrics import registry
         from ..utils.tracing import span
 
         with span("block:get"):
-            data = await self._rpc_get_block(hash32, prio, order_tag)
+            parts = [
+                c
+                async for c in self._get_block_chunks(hash32, prio, order_tag)
+            ]
+        data = parts[0] if len(parts) == 1 else b"".join(parts)
         registry.incr("block_bytes_read", by=len(data))
         return data
 
-    async def _rpc_get_block(
+    def start_block_read(
         self, hash32: bytes, prio: int = PRIO_NORMAL, order_tag=None
-    ) -> bytes:
+    ) -> "BlockRead":
+        """Begin fetching NOW (a pump task drives the piece machinery)
+        and hand back a streamable handle — the S3 GET pipeline
+        (api/s3/objects.py) prefetches a window of these so block N's
+        systematic pieces stream out while blocks N+1.. are in flight."""
+        return BlockRead(self, hash32, prio, order_tag)
+
+    async def _get_block_chunks(self, hash32: bytes, prio, order_tag=None):
+        """Plaintext chunks of one block, in order (the shared backend of
+        rpc_get_block / BlockRead)."""
         from ..utils.latency import phase_span
 
         if self.codec.n_pieces == 1:
             with phase_span("piece_fetch"):
-                local = await self.read_block_local(hash32)
-                if local is not None:
-                    return local
-                nodes = self.helper.request_order(self.read_nodes_of(hash32))
-                errors = []
-                for n in nodes:
-                    if n == self.system.id:
+                data = await self._replica_get(hash32, prio, order_tag)
+            yield data
+            return
+        async for chunk in self._ec_get_stream(hash32, prio, order_tag):
+            yield chunk
+
+    # --- replica read path ----------------------------------------------------
+
+    async def _replica_get(self, hash32: bytes, prio, order_tag=None) -> bytes:
+        """Replica-mode block read: local disk, then the hot-block cache,
+        then peers raced through the hedge helper — a slow first replica
+        costs one hedge delay, not a full adaptive timeout (ISSUE 13
+        satellite; the old loop walked peers strictly sequentially)."""
+        local = await self.read_block_local(hash32)
+        if local is not None:
+            return local
+        cached = self.read_cache.get(hash32)
+        if cached is not None:
+            return cached
+        nodes = [
+            n
+            for n in self.helper.request_order(self.read_nodes_of(hash32))
+            if n != self.system.id
+        ]
+        if not nodes:
+            raise Error(f"block {hash32.hex()[:16]} unavailable: no peers")
+        foreground = prio != PRIO_BACKGROUND
+        data = await self._hedged_race(
+            [
+                (n, lambda n=n: self._fetch_replica(n, hash32, prio, order_tag))
+                for n in nodes
+            ],
+            self._hedge_delay(nodes),
+            what=f"block {hash32.hex()[:16]}",
+            hedge=foreground,
+        )
+        # FOREGROUND remote fetches cache (repeat GETs become memory
+        # reads); local disk reads don't — the page cache already holds
+        # those bytes — and neither do background-priority reads: a
+        # resync/rebalance sweep inserting thousands of cold blocks
+        # would evict the hot set exactly while foreground latency
+        # matters (background reads may still HIT the cache above)
+        if foreground:
+            self.read_cache.put(hash32, data)
+        return data
+
+    async def _fetch_replica(
+        self, node: bytes, hash32: bytes, prio, order_tag=None
+    ) -> bytes:
+        # health-tracked + retried: a sick peer fast-fails (circuit
+        # breaker) instead of stalling the GET, and transient transport
+        # blips retry with jittered backoff
+        resp = await self.helper.call(
+            self.endpoint, node, ["Get", hash32], prio=prio,
+            order_tag=order_tag, idempotent=True,
+        )
+        declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
+        # reserve before buffering; held through decompress+verify
+        async with self.buffers.reserve(declared):
+            meta, stored = await _resp_payload(resp)
+            data = zstandard.decompress(stored) if meta.get("c") else stored
+            if blake2sum(data) != hash32:
+                raise Error("hash mismatch from peer")
+            return data
+
+    # --- hedging (ISSUE 13) ---------------------------------------------------
+
+    def _count_hedge(self, outcome: str) -> None:
+        from ..utils.metrics import registry
+
+        registry.incr("block_read_hedges_total", (("outcome", outcome),))
+
+    def _hedge_delay(self, nodes: list[bytes]) -> float:
+        """Seconds a fetch may stay unanswered before a hedge launches:
+        RTT-derived from the slowest HEALTHY candidate's piece-fetch /
+        rtt EWMA (sick peers are hedged immediately, never waited on),
+        floored at `[block] read_hedge_min_msec`."""
+        health = self.helper.health
+        est = 0.0
+        for n in nodes:
+            if n == self.system.id or health.is_sick(n):
+                continue
+            e = health.fetch_latency_estimate(n)
+            if e is not None:
+                est = max(est, e)
+        cfg = self.block_config
+        return max(
+            cfg.read_hedge_min_msec / 1e3, est * cfg.read_hedge_rtt_mult
+        )
+
+    def _victim_order(self, ranks: list[int], nodes: list[bytes]) -> list[int]:
+        """Hedge-victim priority among outstanding ranks: sick/breaker-
+        open peers first, then slowest by the per-peer piece-fetch
+        ranking (rpc/peer_health.py — the PR 12 slow-rank feed)."""
+        pos = {
+            row["peer"]: i
+            for i, row in enumerate(self.helper.health.piece_fetch_ranking())
+        }
+        return sorted(ranks, key=lambda r: pos.get(nodes[r].hex(), len(pos)))
+
+    async def _hedged_race(
+        self, attempts, delay: float, what: str, hedge: bool = True
+    ):
+        """Race a candidate list with hedging (replica GET path): start
+        the first attempt; when nothing has answered within `delay` of
+        the last event, start the next candidate as a hedge; a FAILED
+        attempt is replaced immediately (failover, not counted).  First
+        success wins; losers are cancelled and drained.  `attempts` is
+        [(node, coro_factory)] in preference order.  `hedge=False`
+        (background-priority reads) keeps the sequential failover but
+        never races extra fetches — resync must not amplify load."""
+        tasks: dict[asyncio.Task, tuple[bytes, bool]] = {}
+        counted: set[asyncio.Task] = set()
+        errors: list[str] = []
+        idx = 0
+        hedge_on = hedge and self.block_config.read_hedge_enabled
+
+        def launch(is_hedge: bool) -> None:
+            nonlocal idx
+            node, factory = attempts[idx]
+            idx += 1
+            t = asyncio.create_task(factory())
+            tasks[t] = (node, is_hedge)
+
+        try:
+            launch(False)
+            while True:
+                pending = [t for t in tasks if not t.done()]
+                if not pending:
+                    if idx < len(attempts):
+                        launch(False)  # every prior attempt failed
                         continue
-                    try:
-                        # health-tracked + retried: a sick peer fast-fails
-                        # (circuit breaker) instead of stalling the GET,
-                        # and transient transport blips retry with
-                        # jittered backoff
-                        resp = await self.helper.call(
-                            self.endpoint, n, ["Get", hash32], prio=prio,
-                            order_tag=order_tag, idempotent=True,
-                        )
-                        declared = int(resp.body[1].get("s", 4 * 1024 * 1024))
-                        # reserve before buffering; held through
-                        # decompress+verify
-                        async with self.buffers.reserve(declared):
-                            meta, stored = await _resp_payload(resp)
-                            data = (
-                                zstandard.decompress(stored)
-                                if meta.get("c")
-                                else stored
-                            )
-                            if blake2sum(data) != hash32:
-                                raise Error("hash mismatch from peer")
-                            return data
-                    except Exception as e:  # noqa: BLE001
-                        errors.append(f"{n.hex()[:8]}: {e!r}")
-                raise Error(
-                    f"block {hash32.hex()[:16]} unavailable: {errors}"
+                    raise Error(f"{what} unavailable: {errors}")
+                timeout = (
+                    delay if (hedge_on and idx < len(attempts)) else None
                 )
-        return await self._ec_get(hash32, prio, order_tag)
+                done, _ = await asyncio.wait(
+                    pending, timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    # hedge window expired: race the next candidate
+                    # against the slow in-flight one
+                    launch(True)
+                    continue
+                winner = None
+                for t in done:
+                    node, is_hedge = tasks[t]
+                    exc = t.exception()
+                    if exc is None:
+                        winner = t
+                        break
+                    errors.append(f"{node.hex()[:8]}: {exc!r}")
+                    if is_hedge and t not in counted:
+                        counted.add(t)
+                        self._count_hedge("failed")
+                    # replace the failure NOW even while another attempt
+                    # is still pending — waiting out a second hedge
+                    # window for the next candidate is exactly the stall
+                    # this helper exists to avoid
+                    if idx < len(attempts):
+                        launch(False)
+                if winner is None:
+                    continue
+                for t, (_n, is_hedge) in tasks.items():
+                    if is_hedge and t not in counted:
+                        counted.add(t)
+                        self._count_hedge(
+                            "won" if t is winner else "lost"
+                        )
+                return winner.result()
+        finally:
+            leftovers = [t for t in tasks if not t.done()]
+            if leftovers:
+                from ..utils.aio import reap
+
+                await reap(
+                    leftovers, log=logger, what=f"{what} read attempt"
+                )
 
     async def _fetch_piece(
         self, node: bytes, hash32: bytes, piece: int, prio, order_tag=None
@@ -821,8 +988,6 @@ class BlockManager:
             if found[1]:
                 stored = zstandard.decompress(stored)
             return unwrap_piece(stored)
-        import time
-
         t0 = time.perf_counter()
         resp = await self.helper.call(
             self.endpoint, node, ["Get", hash32, piece], prio=prio,
@@ -860,7 +1025,6 @@ class BlockManager:
         stay readable whichever node set survives."""
         layout = self.system.layout_manager.history
         nodes = layout.current().nodes_of(hash32)
-        all_nodes = self.storage_nodes_of(hash32)  # union of active versions
         pieces: dict[int, bytes] = {}
         block_len = -1
         errors: list[str] = []
@@ -887,30 +1051,12 @@ class BlockManager:
             else:
                 block_len, pieces[i] = r
         if len(pieces) < want_k:
-            # slow path: ask every node which pieces it holds, take any k
-            for n in self.helper.request_order(all_nodes):
-                if len(pieces) >= want_k:
-                    break
-                if exclude_self and n == self.system.id:
-                    continue
-                try:
-                    resp = await self.helper.call(
-                        self.endpoint, n, ["Pieces", hash32], prio=prio,
-                        idempotent=True,
-                    )
-                    for pi in resp.body or []:
-                        pi = int(pi)
-                        if pi not in pieces:
-                            try:
-                                block_len, pieces[pi] = await self._fetch_piece(
-                                    n, hash32, pi, prio
-                                )
-                            except Exception as e:  # noqa: BLE001
-                                errors.append(f"piece {pi}@{n.hex()[:8]}: {e!r}")
-                        if len(pieces) >= want_k:
-                            break
-                except Exception as e:  # noqa: BLE001
-                    errors.append(f"pieces@{n.hex()[:8]}: {e!r}")
+            blen2 = await self._gather_more(
+                hash32, want_k, pieces, errors, prio,
+                order_tag=order_tag, exclude_self=exclude_self,
+            )
+            if blen2 != -1:
+                block_len = blen2
         if len(pieces) < want_k:
             raise Error(
                 f"block {hash32.hex()[:16]}: only {len(pieces)}/{want_k} "
@@ -918,21 +1064,300 @@ class BlockManager:
             )
         return block_len, pieces
 
-    async def _ec_get(self, hash32: bytes, prio, order_tag=None) -> bytes:
-        """Gather k pieces and decode; the plaintext block hash is verified
-        after decode, so corrupted pieces are caught end-to-end."""
+    async def _gather_more(
+        self, hash32: bytes, want_k: int, pieces: dict[int, bytes],
+        errors: list[str], prio, order_tag=None, exclude_self=False,
+    ) -> int:
+        """Slow-path gather: ask every node of EVERY active version what
+        it holds and fetch missing pieces until `want_k` — blocks written
+        mid-migration span versions, so rank-placement assumptions don't
+        hold.  Mutates `pieces`/`errors` in place; returns the last
+        learned block_len (-1 when nothing new was fetched).  `order_tag`
+        is threaded through every fetch (ISSUE 13 satellite: it used to
+        be dropped here, losing multi-block GET response pipelining
+        exactly when the cluster was degraded)."""
+        block_len = -1
+        for n in self.helper.request_order(self.storage_nodes_of(hash32)):
+            if len(pieces) >= want_k:
+                break
+            if exclude_self and n == self.system.id:
+                continue
+            try:
+                resp = await self.helper.call(
+                    self.endpoint, n, ["Pieces", hash32], prio=prio,
+                    idempotent=True,
+                )
+                for pi in resp.body or []:
+                    pi = int(pi)
+                    if pi not in pieces:
+                        try:
+                            block_len, pieces[pi] = await self._fetch_piece(
+                                n, hash32, pi, prio, order_tag=order_tag
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(f"piece {pi}@{n.hex()[:8]}: {e!r}")
+                    if len(pieces) >= want_k:
+                        break
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"pieces@{n.hex()[:8]}: {e!r}")
+        return block_len
+
+    async def _decode_pieces(
+        self, pieces: dict[int, bytes], blen: int
+    ) -> bytes:
+        """Degraded-read decode: coalesced through the batcher's decode
+        lane (concurrent degraded GETs share one grouped reconstruction
+        dispatch), else a single worker-thread dispatch — either way the
+        codec math stays off the event loop."""
+        if self.batcher is not None:
+            return await self.batcher.decode(pieces, blen)
+        return await asyncio.to_thread(self.codec.decode, pieces, blen)
+
+    async def _ec_get_stream(self, hash32: bytes, prio, order_tag=None):
+        """The EC GET pipeline (ISSUE 13): an async generator of
+        plaintext chunks.
+
+        Fast path: for ec:k:m the k systematic pieces ARE the plaintext,
+        so all k are fetched concurrently and piece i streams to the
+        caller while piece i+1 is still in flight — zero decode, counted
+        `path="systematic"` via the codec's read hook.  Systematic ranks
+        on sick/breaker-open peers are hedged to parity ranks
+        IMMEDIATELY (never waited on); the rest get one hedge round when
+        nothing lands within the RTT-derived hedge delay, victims
+        ordered by the per-peer slow-rank ranking.  The moment any k
+        pieces are on hand while the next systematic piece is not, the
+        stream falls back to reconstruction with whichever k landed
+        first (`path="reconstruct"`, coalesced through the batcher's
+        decode lane).  If even that cannot reach k, the ask-every-node
+        slow path covers mid-migration blocks.
+
+        Integrity: every remote piece carries its own BLAKE3 (GTP2
+        header, verified in unwrap_piece), so streamed chunks are
+        piece-level-verified; the end-to-end plaintext hash check still
+        runs before the generator finishes, so an inconsistent assembly
+        surfaces as a mid-stream error (the consumer aborts the
+        connection) and is never cached."""
         from ..utils.latency import phase_span
 
+        cached = self.read_cache.get(hash32)
+        if cached is not None:
+            yield cached
+            return
+
         k = self.codec.min_pieces
-        with phase_span("piece_fetch"):
-            blen, pieces = await self.gather_pieces(
-                hash32, k, prio, order_tag=order_tag
+        layout = self.system.layout_manager.history
+        nodes = layout.current().nodes_of(hash32)
+        health = self.helper.health
+        n_av = min(self.codec.n_pieces, len(nodes))
+        sys_ranks = list(range(min(k, n_av)))
+
+        results: dict[int, bytes] = {}  # rank -> piece payload
+        order: list[int] = []  # rank completion order
+        failed: dict[int, str] = {}
+        errors: list[str] = []
+        tasks: dict[asyncio.Task, int] = {}
+        by_rank: dict[int, asyncio.Task] = {}
+        counted_hedges: set[int] = set()  # parity ranks launched as hedges
+        used: set[int] = set()  # ranks whose bytes served the read
+        blen: int | None = None
+
+        # healthy parity ranks are better hedge targets than sick ones
+        parity_pool = sorted(
+            range(k, n_av),
+            key=lambda r: 1 if health.is_sick(nodes[r]) else 0,
+        )
+
+        def launch(rank: int) -> None:
+            t = asyncio.create_task(
+                self._fetch_piece(
+                    nodes[rank], hash32, rank, prio, order_tag=order_tag
+                )
             )
-        with phase_span("decode"):
-            data = self.codec.decode(pieces, blen)
-            if blake2sum(data) != hash32:
-                raise Error("EC decode does not match block hash")
-        return data
+            tasks[t] = rank
+            by_rank[rank] = t
+
+        def inflight() -> int:
+            return sum(1 for t in tasks if not t.done())
+
+        def launch_parity(as_hedge: bool) -> bool:
+            while parity_pool:
+                r = parity_pool.pop(0)
+                if r in by_rank:
+                    continue
+                launch(r)
+                if as_hedge:
+                    counted_hedges.add(r)
+                return True
+            return False
+
+        # background-priority reads (resync handoffs) neither hedge nor
+        # cache: a cold-block sweep must not amplify cluster load or
+        # evict the hot set (they may still HIT the cache above)
+        foreground = prio != PRIO_BACKGROUND
+        hedge_on = (
+            foreground and self.block_config.read_hedge_enabled and n_av > k
+        )
+        for r in sys_ranks:
+            launch(r)
+        if hedge_on:
+            # sick/breaker-open systematic ranks are hedged up front —
+            # their own fetch may still win (a breaker fast-fail costs
+            # nothing), but the read never WAITS on them
+            sick = [
+                r for r in sys_ranks
+                if nodes[r] != self.system.id and health.is_sick(nodes[r])
+            ]
+            for r in self._victim_order(sick, nodes):
+                if not launch_parity(as_hedge=True):
+                    break
+        deadline = (
+            time.monotonic()
+            + self._hedge_delay([nodes[r] for r in sys_ranks])
+            if hedge_on
+            else None
+        )
+
+        emitted = 0  # next systematic rank to stream
+        emitted_bytes = 0
+        out_parts: list[bytes] = []
+        data: bytes | None = None  # set on the reconstruction paths
+
+        try:
+            while True:
+                # stream the ready systematic prefix
+                while emitted < k and emitted in results and blen is not None:
+                    piece = results[emitted]
+                    used.add(emitted)
+                    chunk = piece[: max(0, blen - emitted * len(piece))]
+                    emitted += 1
+                    if chunk:
+                        out_parts.append(chunk)
+                        emitted_bytes += len(chunk)
+                        yield chunk
+                if emitted >= k:
+                    break  # fully systematic: everything streamed
+                if len(results) >= k:
+                    # the next systematic piece is missing but k pieces
+                    # are on hand: reconstruct with whichever k landed
+                    # first (landed data ranks preferred — no matrix
+                    # work for shards already in memory)
+                    use_ranks = [r for r in order if r < k][:k]
+                    for r in order:
+                        if len(use_ranks) >= k:
+                            break
+                        if r >= k:
+                            use_ranks.append(r)
+                    used.update(use_ranks)
+                    with phase_span("decode"):
+                        data = await self._decode_pieces(
+                            {r: results[r] for r in use_ranks}, blen
+                        )
+                    break
+                live = [t for t in tasks if not t.done()]
+                if not live:
+                    # fast path exhausted below k: mid-migration blocks
+                    # keep their pieces under older layout versions
+                    pieces = dict(results)
+                    with phase_span("piece_fetch"):
+                        blen2 = await self._gather_more(
+                            hash32, k, pieces, errors, prio,
+                            order_tag=order_tag,
+                        )
+                    if len(pieces) < k:
+                        raise Error(
+                            f"block {hash32.hex()[:16]}: only "
+                            f"{len(pieces)}/{k} pieces reachable: {errors}"
+                        )
+                    if blen is None:
+                        blen = blen2
+                    used.update(pieces)
+                    with phase_span("decode"):
+                        data = await self._decode_pieces(pieces, blen)
+                    break
+                timeout = None
+                if deadline is not None:
+                    timeout = max(0.0, deadline - time.monotonic())
+                with phase_span("piece_fetch"):
+                    done, _ = await asyncio.wait(
+                        live, timeout=timeout,
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                if not done:
+                    # hedge window expired: hedge every outstanding
+                    # systematic rank, sickest/slowest victims first
+                    deadline = None
+                    outstanding = [
+                        r for r in sys_ranks
+                        if r not in results and r not in failed
+                    ]
+                    for r in self._victim_order(outstanding, nodes):
+                        if not launch_parity(as_hedge=True):
+                            break
+                    continue
+                for t in done:
+                    rank = tasks[t]
+                    if rank in results or rank in failed:
+                        continue
+                    exc = t.exception()
+                    if exc is not None:
+                        failed[rank] = repr(exc)
+                        errors.append(
+                            f"piece {rank}@{nodes[rank].hex()[:8]}: {exc!r}"
+                        )
+                        # replace a FAILED fetch immediately while a
+                        # deficit remains (failover, not a timed hedge)
+                        if len(results) + inflight() < k:
+                            launch_parity(as_hedge=False)
+                    else:
+                        blen_r, piece = t.result()
+                        if blen is None:
+                            blen = blen_r
+                        results[rank] = piece
+                        order.append(rank)
+
+            if data is not None:
+                # reconstruction path: verify BEFORE streaming the
+                # remainder (the already-streamed prefix is exactly the
+                # landed data shards the decode reused, and each carried
+                # its own piece hash)
+                if blake2sum(data) != hash32:
+                    raise Error("EC decode does not match block hash")
+                rest = data[emitted_bytes:]
+                if rest:
+                    yield rest
+                if foreground:
+                    self.read_cache.put(hash32, data)
+            else:
+                plain = (
+                    out_parts[0] if len(out_parts) == 1 else b"".join(out_parts)
+                )
+                if blake2sum(plain) != hash32:
+                    raise Error(
+                        "EC systematic read does not match block hash"
+                    )
+                # the join happened HERE (piece-by-piece, streamed), so
+                # the codec never saw a decode() — report it so the
+                # op="decode" systematic/reconstruct split stays honest
+                note = getattr(self.codec, "note_systematic_read", None)
+                if note is not None:
+                    note(len(plain))
+                if foreground:
+                    self.read_cache.put(hash32, plain)
+        finally:
+            # hedge accounting + straggler cleanup (a systematic
+            # completion leaves its hedges in flight by design)
+            for r in counted_hedges:
+                if r in used:
+                    self._count_hedge("won")
+                elif r in failed:
+                    self._count_hedge("failed")
+                else:
+                    self._count_hedge("lost")
+            leftovers = [t for t in tasks if not t.done()]
+            if leftovers:
+                from ..utils.aio import reap
+
+                await reap(leftovers, log=logger, what="ec-get piece fetch")
 
     def _verify_gathered(self, hash32: bytes, pieces: dict[int, bytes], blen: int):
         """Reject reconstruction inputs whose decoded block doesn't match
@@ -1033,3 +1458,70 @@ class BlockManager:
             )
             n += 1
         return n
+
+
+_READ_EOF = object()
+
+
+class BlockRead:
+    """One in-flight block read (the S3 GET pipeline's unit of
+    prefetch, api/s3/objects.py): fetching starts at CONSTRUCTION in a
+    supervised pump task — context captured at spawn keeps its phase
+    spans on the requesting trace, the EC-PUT-sender pattern — so a
+    window of BlockReads overlaps across blocks while `chunks()`
+    streams each block's systematic pieces in arrival order within it.
+
+    The queue holds at most one block's worth of chunks (the pump
+    produces one block), so per-read RAM is bounded by block_size just
+    like the assembled form was."""
+
+    def __init__(self, mgr: BlockManager, hash32: bytes, prio, order_tag):
+        from ..utils.background import spawn
+
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._task = spawn(
+            self._pump(mgr, hash32, prio, order_tag),
+            name=f"block-read-{hash32.hex()[:8]}",
+        )
+
+    async def _pump(self, mgr, hash32, prio, order_tag) -> None:
+        from ..utils.metrics import registry
+        from ..utils.tracing import span
+
+        try:
+            total = 0
+            with span("block:get"):
+                async for chunk in mgr._get_block_chunks(
+                    hash32, prio, order_tag
+                ):
+                    total += len(chunk)
+                    self._q.put_nowait(chunk)
+            registry.incr("block_bytes_read", by=total)
+            self._q.put_nowait(_READ_EOF)
+        except asyncio.CancelledError:
+            # unblock a consumer racing the abort, then end CANCELLED
+            self._q.put_nowait(Error("block read aborted"))
+            raise
+        except Exception as e:  # noqa: BLE001 — delivered to the consumer
+            self._q.put_nowait(e)
+
+    async def chunks(self):
+        """Plaintext chunks in block order; raises what the fetch
+        raised."""
+        while True:
+            item = await self._q.get()
+            if item is _READ_EOF:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    async def bytes(self) -> bytes:
+        parts = [c async for c in self.chunks()]
+        return parts[0] if len(parts) == 1 else b"".join(parts)
+
+    async def abort(self) -> None:
+        """Cancel + drain the pump (consumer-gone teardown)."""
+        from ..utils.aio import reap
+
+        await reap([self._task], log=logger, what="block read")

@@ -302,6 +302,24 @@ class Garage:
             lambda v: setattr(_batcher(), "max_blocks", max(1, int(v))),
         )
 
+        # read path (ISSUE 13): hot-block cache budget resizes live
+        # (shrinking evicts immediately); the hedge-delay floor applies
+        # to the next read (the manager reads block_config per GET)
+        self.bg_vars.register_rw(
+            "read-cache-bytes",
+            lambda: str(self.block_manager.read_cache.max_bytes),
+            lambda v: self.block_manager.read_cache.set_max_bytes(int(v)),
+        )
+        self.bg_vars.register_rw(
+            "read-hedge-min-msec",
+            lambda: str(self.block_manager.block_config.read_hedge_min_msec),
+            lambda v: setattr(
+                self.block_manager.block_config,
+                "read_hedge_min_msec",
+                max(0.0, float(v)),
+            ),
+        )
+
         def _scrub_worker():
             sw = getattr(self.block_manager, "scrub_worker", None)
             if sw is None:

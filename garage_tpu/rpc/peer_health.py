@@ -243,6 +243,19 @@ class PeerHealth:
             else (1 - a) * p.piece_bytes_ewma + a * nbytes
         )
 
+    def fetch_latency_estimate(self, node: bytes) -> float | None:
+        """Best available latency estimate (seconds) for one remote
+        piece/block fetch from `node`: the piece-fetch EWMA when this
+        node has fetched from it before (it folds in the peer's DISK,
+        not just its transport), else the all-RPC rtt EWMA, else None.
+        The hedged-read delay (block/manager.py) seeds from this."""
+        p = self.peers.get(node)
+        if p is None:
+            return None
+        if p.piece_lat_ewma is not None:
+            return p.piece_lat_ewma
+        return p.rtt_ewma
+
     def piece_fetch_ranking(self) -> list[dict]:
         """Slowest-first per-peer read attribution: sick / breaker-open
         peers rank ahead of everything (they are the slowest a read can

@@ -200,6 +200,17 @@ class BlockConfig:
     # (replica-path zstd, content hashing): below it the thread-hop
     # overhead exceeds the stall it avoids
     cpu_offload_min_bytes: int = 64 * 1024
+    # EC read path (ISSUE 13, doc/monitoring.md read-path runbook):
+    # hot-block cache budget — a bounded-bytes LRU of assembled
+    # plaintext blocks per node (0 disables; live `worker set
+    # read-cache-bytes`)
+    read_cache_bytes: int = 128 * 1024 * 1024
+    # hedged reads: when a fetch stays unanswered past an RTT-derived
+    # delay (slowest healthy peer's EWMA x mult, floored at min), a
+    # hedge launches to the next candidate / a parity rank
+    read_hedge_enabled: bool = True
+    read_hedge_min_msec: float = 30.0
+    read_hedge_rtt_mult: float = 4.0
 
 
 @dataclass
@@ -577,6 +588,15 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         )
     if int(blk.cpu_offload_min_bytes) < 0:
         raise ValueError("block.cpu_offload_min_bytes must be >= 0")
+    # read-path knobs (ISSUE 13): a negative cache budget is nonsense
+    # (0 = disabled is fine); a zero/negative hedge multiplier would
+    # hedge every read unconditionally the moment any EWMA exists
+    if int(blk.read_cache_bytes) < 0:
+        raise ValueError("block.read_cache_bytes must be >= 0")
+    if float(blk.read_hedge_min_msec) < 0:
+        raise ValueError("block.read_hedge_min_msec must be >= 0")
+    if float(blk.read_hedge_rtt_mult) <= 0:
+        raise ValueError("block.read_hedge_rtt_mult must be > 0")
     # resolve secrets
     cfg.rpc_secret = _get_secret(
         cfg.rpc_secret,

@@ -418,16 +418,21 @@ def _run_jepsen(tmp_path, mode, run_seconds=RUN_SECONDS):
                 # backoff — see the comment in check_set2
                 deadline = time.monotonic() + 75
                 got = -1
+                last_exc: Exception | None = None
                 while time.monotonic() < deadline:
                     try:
                         raw = await clients[0].get_object("jepsen", k)
                         got = int(raw.split(b":")[0])
+                        last_exc = None
                         if got >= last:
                             break
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception as e:  # noqa: BLE001 — retried; kept
+                        last_exc = e  # ...as data for the failure message
                     await asyncio.sleep(0.5)
-                assert got >= last, f"{k}: acked v{last} lost (read v{got})"
+                assert got >= last, (
+                    f"{k}: acked v{last} lost (read v{got}; last error "
+                    f"during the 75 s retry window: {last_exc!r})"
+                )
 
             await check_set2(hist, clients[1])
         except AssertionError:

@@ -327,6 +327,11 @@ def test_ec83_cluster_xray_acceptance(tmp_path):
         garages = await make_ec_cluster(
             tmp_path, n=11, mode="ec:8:3", block_size=65536
         )
+        # this test asserts the HEALTHY-path phase shape (no "decode"
+        # span on the GET waterfall) — pin hedged reads off so a box
+        # stall past the 30 ms floor can't race in a reconstruction
+        for g in garages:
+            g.block_manager.block_config.read_hedge_enabled = False
         s3 = S3ApiServer(garages[0])
         await s3.start("127.0.0.1", 0)
         ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
@@ -388,7 +393,11 @@ def test_ec83_cluster_xray_acceptance(tmp_path):
                 put["phases"]
             ), put["phases"].keys()
             get = lat["ops"]["get"]
-            assert {"piece_fetch", "decode"} <= set(get["phases"])
+            # no "decode" phase on a healthy cluster: since ISSUE 13 the
+            # EC GET streams the k systematic pieces with ZERO decode —
+            # a decode span here would mean the fast path regressed
+            assert "piece_fetch" in get["phases"]
+            assert "decode" not in get["phases"], get["phases"].keys()
 
             # phase histograms exported, all labels in the catalogue
             async with aiohttp.ClientSession() as sess:

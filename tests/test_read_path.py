@@ -1,0 +1,481 @@
+"""EC read pipeline (ISSUE 13, block/manager.py): hot-block cache
+bounds + per-node isolation, hedged fetches past slow/dead systematic
+ranks, batched decode coalescing, order-tag threading on the degraded
+slow path, and the streamed range GET — plus the slow 11-node EC(8,3)
+degraded-read acceptance."""
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_ec_cluster import make_ec_cluster, stop_cluster
+
+from garage_tpu.block.manager import BlockManager
+from garage_tpu.block.read_cache import BlockCache
+from garage_tpu.net.fault import FaultPlan, FaultRule
+from garage_tpu.net.message import PRIO_NORMAL
+from garage_tpu.utils.config import BlockConfig
+from garage_tpu.utils.metrics import registry
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _ctr(name: str) -> float:
+    return registry.counter_family_sum(name)
+
+
+def _hedges(outcome: str) -> float:
+    return registry.counters.get(
+        ("block_read_hedges_total", (("outcome", outcome),)), 0
+    )
+
+
+def _decodes(path: str) -> float:
+    return registry.counters.get(
+        ("block_codec_blocks_total", (("op", "decode"), ("path", path))), 0
+    )
+
+
+# --- hot-block cache (unit) ---------------------------------------------------
+
+
+def test_block_cache_lru_eviction_and_bounds():
+    c = BlockCache(max_bytes=300)
+    try:
+        blocks = {bytes([i]) * 32: bytes([i]) * 100 for i in range(5)}
+        ev0 = _ctr("block_cache_evictions_total")
+        for h, data in blocks.items():
+            c.put(h, data)
+        # 5 x 100 bytes into a 300-byte budget: 2 evicted, LRU first
+        assert c.bytes_used <= 300
+        assert len(c) == 3
+        assert _ctr("block_cache_evictions_total") - ev0 == 2
+        hashes = list(blocks)
+        assert c.get(hashes[0]) is None  # oldest evicted
+        assert c.get(hashes[4]) == blocks[hashes[4]]
+        # a get refreshes recency: 2 (just read) survives inserting 5's
+        # replacement, 3 does not
+        assert c.get(hashes[2]) == blocks[hashes[2]]
+        c.put(b"f" * 32, b"x" * 100)
+        assert c.get(hashes[2]) is not None
+        assert c.get(hashes[3]) is None
+        # oversized entries are skipped, not force-fitted
+        c.put(b"g" * 32, b"y" * 1000)
+        assert c.bytes_used <= 300
+        # live shrink evicts down; 0 disables and empties
+        c.set_max_bytes(100)
+        assert c.bytes_used <= 100 and len(c) == 1
+        c.set_max_bytes(0)
+        assert len(c) == 0
+        h0, m0 = _ctr("block_cache_hits_total"), _ctr("block_cache_misses_total")
+        assert c.get(hashes[4]) is None  # disabled: no counting either
+        assert _ctr("block_cache_hits_total") == h0
+        assert _ctr("block_cache_misses_total") == m0
+    finally:
+        c.close()
+
+
+def test_block_cache_gauge_registered_and_unregistered():
+    before = {k for k in registry._gauge_fns if k[0] == "block_cache_bytes"}
+    c = BlockCache(max_bytes=100)
+    during = {k for k in registry._gauge_fns if k[0] == "block_cache_bytes"}
+    assert len(during) == len(before) + 1
+    c.put(b"h" * 32, b"x" * 60)
+    (key,) = during - before
+    assert registry._gauge_fns[key]() == 60.0
+    c.close()
+    after = {k for k in registry._gauge_fns if k[0] == "block_cache_bytes"}
+    assert after == before
+
+
+# --- hedge helper (unit, no cluster) -----------------------------------------
+
+
+class _HedgeStub:
+    """Just enough BlockManager surface for _hedged_race."""
+
+    block_config = BlockConfig()
+    _count_hedge = BlockManager._count_hedge
+    _hedged_race = BlockManager._hedged_race
+
+
+def test_hedged_race_slow_primary_loses_to_hedge():
+    async def main():
+        async def slow():
+            await asyncio.sleep(5.0)
+            return "slow"
+
+        async def fast():
+            return "fast"
+
+        won0 = _hedges("won")
+        stub = _HedgeStub()
+        t0 = time.perf_counter()
+        res = await stub._hedged_race(
+            [(b"\x01" * 32, slow), (b"\x02" * 32, fast)], 0.05, "test"
+        )
+        assert res == "fast"
+        assert time.perf_counter() - t0 < 2.0  # one hedge delay, not 5 s
+        assert _hedges("won") - won0 == 1
+
+    run(main())
+
+
+def test_hedged_race_failed_attempt_fails_over_without_hedge_delay():
+    async def main():
+        async def bad():
+            raise RuntimeError("nope")
+
+        async def good():
+            return "ok"
+
+        won0, failed0 = _hedges("won"), _hedges("failed")
+        stub = _HedgeStub()
+        t0 = time.perf_counter()
+        res = await stub._hedged_race(
+            [(b"\x01" * 32, bad), (b"\x02" * 32, good)], 30.0, "test"
+        )
+        # failover is immediate (no 30 s hedge window) and not a hedge
+        assert res == "ok"
+        assert time.perf_counter() - t0 < 5.0
+        assert _hedges("won") == won0
+        assert _hedges("failed") == failed0
+
+    run(main())
+
+
+# --- cluster tests (ec:2:1, 3 nodes) -----------------------------------------
+
+
+async def _put_one_block(g0, size=6000):
+    from garage_tpu.utils.data import blake2sum
+
+    data = os.urandom(size)
+    h = blake2sum(data)
+    await g0.block_manager.rpc_put_block(h, data)
+    return h, data
+
+
+def test_ec_get_hedges_past_faultplan_slowed_systematic_rank(tmp_path):
+    """A FaultPlan-slowed systematic rank must cost one hedge delay, not
+    the injected latency: the hedge fetches the parity piece and the GET
+    completes via reconstruction (`path="reconstruct"` counted)."""
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="ec:2:1")
+        try:
+            g0 = garages[0]
+            g0.block_manager.block_config.read_hedge_min_msec = 50.0
+            h, data = await _put_one_block(g0)
+            nodes = (
+                g0.block_manager.system.layout_manager.history.current()
+                .nodes_of(h)
+            )
+            # slow a SYSTEMATIC (data-rank) holder that is not us
+            victim = nodes[0] if nodes[0] != g0.node_id else nodes[1]
+            g0.netapp.fault_plan = FaultPlan(3).set_rule(
+                FaultRule(latency_ms=1500.0), peer=victim
+            )
+            won0, rec0 = _hedges("won"), _decodes("reconstruct")
+            t0 = time.perf_counter()
+            got = await g0.block_manager.rpc_get_block(h)
+            dt = time.perf_counter() - t0
+            assert got == data
+            # the injected 1.5 s never sets the pace
+            assert dt < 1.2, f"GET took {dt:.3f}s despite the hedge"
+            assert _hedges("won") - won0 >= 1
+            assert _decodes("reconstruct") - rec0 >= 1
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
+
+
+def test_replica_get_hedges_past_faultplan_slowed_first_peer(tmp_path):
+    """ISSUE 13 satellite: the replica-path GET rides the same hedge
+    helper — a FaultPlan-slowed first replica costs one hedge delay,
+    not a full adaptive timeout."""
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="2")
+        try:
+            g0 = garages[0]
+            g0.block_manager.block_config.read_hedge_min_msec = 50.0
+            from garage_tpu.utils.data import blake2sum
+
+            # find a block replicated on the two OTHER nodes (RF=2 of 3:
+            # ~1/3 of hashes exclude us), so the read must go remote
+            while True:
+                data = os.urandom(6000)
+                h = blake2sum(data)
+                holders = g0.block_manager.read_nodes_of(h)
+                if g0.node_id not in holders:
+                    break
+            await g0.block_manager.rpc_put_block(h, data)
+            victim = holders[0]
+            # pin the request order so the slowed peer is tried first
+            # (helper_rpc is the RpcHelper the block manager calls through)
+            g0.helper_rpc.request_order = lambda nodes: sorted(
+                nodes, key=lambda n: 0 if n == victim else 1
+            )
+            g0.netapp.fault_plan = FaultPlan(5).set_rule(
+                FaultRule(latency_ms=1500.0), peer=victim
+            )
+            won0 = _hedges("won")
+            t0 = time.perf_counter()
+            got = await g0.block_manager.rpc_get_block(h)
+            dt = time.perf_counter() - t0
+            assert got == data
+            assert dt < 1.2, f"replica GET took {dt:.3f}s despite the hedge"
+            assert _hedges("won") - won0 >= 1
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
+
+
+def test_ec_get_survives_m_killed_ranks(tmp_path):
+    """Killing m nodes of an ec:k:m layout leaves every block readable
+    (reconstruction from the surviving k)."""
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="ec:2:1")
+        stopped = []
+        try:
+            g0 = garages[0]
+            g0.block_manager.block_config.read_hedge_min_msec = 50.0
+            h, data = await _put_one_block(g0)
+            victim_g = next(g for g in garages[1:])
+            await victim_g.stop()
+            stopped.append(victim_g)
+            got = await g0.block_manager.rpc_get_block(h)
+            assert got == data
+        finally:
+            await stop_cluster([g for g in garages if g not in stopped])
+
+    run(main())
+
+
+def test_cache_hits_and_per_node_isolation(tmp_path):
+    """A repeat GET is a cache hit; the cache is per NODE — node B never
+    sees node A's entries (in-process clusters share the process, the
+    PR 6/9 singleton hazard)."""
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="ec:2:1")
+        try:
+            g0, g1 = garages[0], garages[1]
+            h, data = await _put_one_block(g0)
+            hit0 = _ctr("block_cache_hits_total")
+            assert await g0.block_manager.rpc_get_block(h) == data
+            assert len(g0.block_manager.read_cache) == 1
+            # node 1 fetched nothing: ISOLATED, not sharing node 0's hit
+            assert len(g1.block_manager.read_cache) == 0
+            assert await g0.block_manager.rpc_get_block(h) == data
+            assert _ctr("block_cache_hits_total") - hit0 == 1
+            # node 1 assembles its own copy into its own cache
+            assert await g1.block_manager.rpc_get_block(h) == data
+            assert len(g1.block_manager.read_cache) == 1
+            assert len(g0.block_manager.read_cache) == 1
+            # background-priority reads (resync sweeps) must NOT insert:
+            # a cold-block sweep would evict the hot set
+            g2 = garages[2]
+            from garage_tpu.net.message import PRIO_BACKGROUND
+
+            assert await g2.block_manager.rpc_get_block(
+                h, prio=PRIO_BACKGROUND
+            ) == data
+            assert len(g2.block_manager.read_cache) == 0
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
+
+
+def test_concurrent_degraded_gets_coalesce_decodes(tmp_path):
+    """Degraded GETs under load share grouped reconstruction dispatches
+    through the batcher's decode lane instead of N single-block ones."""
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="ec:2:1")
+        try:
+            g0 = garages[0]
+            by_id = {g.node_id: g for g in garages}
+            blocks = []
+            for _ in range(6):
+                h, data = await _put_one_block(g0)
+                blocks.append((h, data))
+            # degrade every block: delete one systematic piece file on
+            # its holder, so the fetch fails fast and the read must
+            # reconstruct from the survivor + parity
+            for h, _ in blocks:
+                nodes = (
+                    g0.block_manager.system.layout_manager.history.current()
+                    .nodes_of(h)
+                )
+                holder = by_id[nodes[0]]
+                found = holder.block_manager.find_block_file(h, piece=0)
+                assert found is not None
+                os.remove(found[0])
+            # a wide linger window so the 6 concurrent decodes coalesce
+            g0.block_manager.batcher.linger_msec = 100.0
+            # fresh reads only
+            g0.block_manager.read_cache.set_max_bytes(0)
+            disp0 = _ctr("block_codec_batch_decode_dispatch_total")
+            rec0 = _decodes("reconstruct")
+            got = await asyncio.gather(
+                *[g0.block_manager.rpc_get_block(h) for h, _ in blocks]
+            )
+            assert [g for g in got] == [d for _h, d in blocks]
+            assert _decodes("reconstruct") - rec0 == 6
+            dispatches = _ctr("block_codec_batch_decode_dispatch_total") - disp0
+            assert 1 <= dispatches <= 3, (
+                f"6 concurrent degraded GETs took {dispatches} decode "
+                "dispatches — the decode lane is not coalescing"
+            )
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
+
+
+def test_gather_slow_path_threads_order_tag(tmp_path):
+    """ISSUE 13 satellite bugfix: the ask-every-node slow path used to
+    drop `order_tag`, losing multi-block GET response pipelining exactly
+    when the cluster was degraded."""
+
+    async def main():
+        garages = await make_ec_cluster(tmp_path, n=3, mode="ec:2:1")
+        try:
+            g0 = garages[0]
+            h, data = await _put_one_block(g0)
+            from garage_tpu.net.message import new_order_stream
+
+            seen = []
+            mgr = g0.block_manager
+            orig = mgr._fetch_piece
+
+            async def spy(node, h32, pi, prio, order_tag=None):
+                seen.append(order_tag)
+                return await orig(node, h32, pi, prio, order_tag=order_tag)
+
+            mgr._fetch_piece = spy
+            tag = new_order_stream().order()
+            pieces: dict[int, bytes] = {}
+            blen = await mgr._gather_more(
+                h, 2, pieces, [], PRIO_NORMAL, order_tag=tag
+            )
+            assert len(pieces) >= 2 and blen > 0
+            assert seen and all(t is tag for t in seen)
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
+
+
+def test_ec_range_get_streams_correct_bytes(tmp_path):
+    """Range GET over a multi-block EC object through the streamed
+    BlockRead pipeline: chunk clipping must reproduce the exact slice."""
+
+    async def main():
+        from garage_tpu.api.s3.api_server import S3ApiServer
+        from garage_tpu.api.s3.client import S3Client
+
+        garages = await make_ec_cluster(tmp_path, n=3, mode="ec:2:1")
+        s3 = S3ApiServer(garages[0])
+        await s3.start("127.0.0.1", 0)
+        key = await garages[0].helper.create_key("rp-test")
+        key.params().allow_create_bucket.update(True)
+        await garages[0].key_table.insert(key)
+        client = S3Client(
+            f"http://127.0.0.1:{s3.runner.addresses[0][1]}",
+            key.key_id, key.secret(),
+        )
+        try:
+            await client.create_bucket("rpbucket")
+            body = os.urandom(40_000)  # 5 blocks at the 8 KiB block size
+            await client.put_object("rpbucket", "blob", body)
+            got = await client.get_object("rpbucket", "blob")
+            assert got == body
+            st, h, part = await client._req(
+                "GET", "/rpbucket/blob", headers={"Range": "bytes=5000-19999"}
+            )
+            assert st == 206
+            assert part == body[5000:20000]
+        finally:
+            await stop_cluster(garages, [s3], [client])
+
+    run(main())
+
+
+# --- 11-node EC(8,3) degraded-read acceptance (slow) --------------------------
+
+
+@pytest.mark.slow
+def test_degraded_read_acceptance_11_nodes(tmp_path):
+    """ISSUE 13 acceptance on the north-star geometry: a FaultPlan-slowed
+    systematic rank no longer sets GET latency (the hedge beats the
+    injected 900 ms), reconstruction is counted, repeat GETs hit the
+    per-node cache, and eviction respects the bytes budget."""
+
+    async def main():
+        garages = await make_ec_cluster(
+            tmp_path, n=11, mode="ec:8:3", block_size=65536
+        )
+        try:
+            g0 = garages[0]
+            g0.block_manager.block_config.read_hedge_min_msec = 60.0
+            h, data = await _put_one_block(g0, size=60_000)
+            nodes = (
+                g0.block_manager.system.layout_manager.history.current()
+                .nodes_of(h)
+            )
+            victim = next(
+                n for n in nodes[:8] if n != g0.node_id
+            )  # a systematic rank we will actually fetch from
+            g0.netapp.fault_plan = FaultPlan(11).set_rule(
+                FaultRule(latency_ms=2000.0), peer=victim
+            )
+            g0.block_manager.read_cache.set_max_bytes(0)  # fresh reads
+            # warmup: connection setup + first-contact noise on a loaded
+            # box must not pollute the timed reads
+            assert await g0.block_manager.rpc_get_block(h) == data
+            won0, rec0 = _hedges("won"), _decodes("reconstruct")
+            durations = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                assert await g0.block_manager.rpc_get_block(h) == data
+                durations.append(time.perf_counter() - t0)
+            assert max(durations) < 1.5, (
+                f"hedge did not beat the injected 2 s latency: {durations}"
+            )
+            assert _hedges("won") - won0 >= 1
+            assert _decodes("reconstruct") - rec0 >= 1
+            # cache: re-enable, assemble once, then hit
+            g0.netapp.fault_plan = None
+            g0.block_manager.read_cache.set_max_bytes(4 * 1024 * 1024)
+            hits0 = _ctr("block_cache_hits_total")
+            assert await g0.block_manager.rpc_get_block(h) == data
+            assert await g0.block_manager.rpc_get_block(h) == data
+            assert _ctr("block_cache_hits_total") - hits0 >= 1
+            # per-node isolation at 11 nodes: only the reading node's
+            # cache holds the block — the other 10 never assembled it
+            assert len(g0.block_manager.read_cache) == 1
+            for g in garages[1:]:
+                assert len(g.block_manager.read_cache) == 0
+            # eviction: shrink below the block size
+            ev0 = _ctr("block_cache_evictions_total")
+            g0.block_manager.read_cache.set_max_bytes(1000)
+            assert _ctr("block_cache_evictions_total") - ev0 >= 1
+            assert g0.block_manager.read_cache.bytes_used <= 1000
+        finally:
+            await stop_cluster(garages)
+
+    run(main())
