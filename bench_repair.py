@@ -189,6 +189,7 @@ async def populate(args, managers, victim_idx):
 
 async def run_bench(args, tmp):
     from garage_tpu.block.codec.ec import EcCodec
+    from garage_tpu.block.durability import DurabilityScanner, ScanParams
     from garage_tpu.block.repair_plan import (
         PlanParams,
         RepairPlanner,
@@ -219,6 +220,21 @@ async def run_bench(args, tmp):
                 batch_blocks=args.batch,
             ),
         )
+        # durability observatory (block/durability.py): the ledger's
+        # time-to-redundancy-restored — the OPERATOR-visible "healed"
+        # moment (zero locally-missing pieces confirmed by a scan pass),
+        # not the planner's own done state
+        scanner = DurabilityScanner(
+            victim,
+            params=ScanParams(tranquility=0, scan_batch=2048),
+            planner_fn=lambda: planner,
+        )
+        before = await scanner.scan_pass()
+        if before["localMissingPieces"] != len(hashes):
+            raise RuntimeError(
+                "ledger missed the wipe: "
+                f"{before['localMissingPieces']}/{len(hashes)}"
+            )
         t0 = time.perf_counter()
         scan_s = None
         for _ in range(1_000_000):
@@ -231,6 +247,19 @@ async def run_bench(args, tmp):
             if state == WorkerState.DONE:
                 break
         elapsed = time.perf_counter() - t0
+        # ledger confirmation: scan until zero local missing pieces (one
+        # pass at steady state; bounded so a broken repair fails loudly)
+        restored_s = None
+        for _ in range(5):
+            after = await scanner.scan_pass()
+            if after["localMissingPieces"] == 0:
+                restored_s = time.perf_counter() - t0
+                break
+        if restored_s is None:
+            raise RuntimeError(
+                "ledger never confirmed restoration: "
+                f"{after['localMissingPieces']} pieces still missing"
+            )
 
         repaired = planner.plan.repaired
         restored = sum(1 for h in hashes if victim.local_pieces(h))
@@ -259,6 +288,7 @@ async def run_bench(args, tmp):
             "rounds": planner.plan.rounds,
             "scan_s": round(scan_s or 0.0, 2),
             "elapsed_s": round(elapsed, 2),
+            "time_to_redundancy_restored_s": round(restored_s, 2),
             "platform": resolved_platform(None),
             "devices": _mesh_width(victim),
             "k": k,

@@ -256,6 +256,16 @@ class AdminApiServer:
 
             return web.json_response(rollup(g))
 
+        if path == "/v1/cluster/durability" and request.method == "GET":
+            # durability observatory (block/durability.py): redundancy
+            # ledger classes, zone-loss exposure, repair ETA and layout
+            # progress — per-node rows from the gossiped dur.* digest
+            # keys plus the local ledger detail.  Zone NAMES live here
+            # (JSON), never as metric labels.
+            from ...block.durability import durability_response
+
+            return web.json_response(durability_response(g))
+
         if path == "/v1/traffic" and request.method == "GET":
             # traffic observatory (rpc/traffic.py): local hot-object /
             # hot-bucket top-K, op mix, size histogram, zipf skew, the

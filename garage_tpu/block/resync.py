@@ -33,6 +33,17 @@ BACKOFF_MAX_MS = 64 * 60 * 1000
 MAX_RESYNC_WORKERS = 8
 
 
+def unpack_error(raw: bytes) -> tuple[int, int, int | None]:
+    """(failure count, next retry msec, first-failure msec).  Error
+    entries written before error-age tracking are 2-element lists —
+    their first-failure time is unknown (None), never fabricated."""
+    import msgpack
+
+    obj = msgpack.unpackb(raw)
+    first = int(obj[2]) if len(obj) > 2 else None
+    return int(obj[0]), int(obj[1]), first
+
+
 class BlockResyncManager:
     def __init__(self, manager):
         self.manager = manager
@@ -42,6 +53,10 @@ class BlockResyncManager:
         self.n_workers = 1
         self.tranquility = 2
         self._kick = asyncio.Event()
+        # oldest-error-age cache: status() runs after every worker
+        # iteration and the durability digest reads it per collection —
+        # neither should pay an O(errors) tree walk each time
+        self._age_cache: tuple[float, float | None] | None = None
 
     # --- queueing -------------------------------------------------------------
 
@@ -79,6 +94,43 @@ class BlockResyncManager:
     def errors_len(self) -> int:
         return len(self.errors)
 
+    def oldest_error_age_secs(self) -> float | None:
+        """Age of the OLDEST entry in the error set (None when empty, or
+        when every entry predates error-age tracking).  Cached ~1 s —
+        callers poll this per worker iteration / digest collection."""
+        import time
+
+        now = time.monotonic()
+        if self._age_cache is not None and now - self._age_cache[0] < 1.0:
+            return self._age_cache[1]
+        oldest: int | None = None
+        for _h, raw in self.errors.iter_range():
+            _count, _next_try, first = unpack_error(raw)
+            if first is not None and (oldest is None or first < oldest):
+                oldest = first
+        age = (
+            max(0.0, (now_msec() - oldest) / 1000.0)
+            if oldest is not None
+            else None
+        )
+        self._age_cache = (now, age)
+        return age
+
+    def error_age_counts(self, stuck_after_secs: float) -> tuple[int, int]:
+        """(transiently-failing, stuck) block counts: an errored block
+        older than `stuck_after_secs` is stuck — retries have been
+        failing long past the first backoff rungs.  Unknown-age entries
+        (pre-upgrade format) count transient."""
+        cutoff = now_msec() - int(stuck_after_secs * 1000)
+        transient = stuck = 0
+        for _h, raw in self.errors.iter_range():
+            _count, _next_try, first = unpack_error(raw)
+            if first is not None and first <= cutoff:
+                stuck += 1
+            else:
+                transient += 1
+        return transient, stuck
+
     # --- one unit of work -----------------------------------------------------
 
     async def resync_iter(self) -> bool:
@@ -92,9 +144,7 @@ class BlockResyncManager:
             # error backoff: skip if a retry is scheduled later
             err = self.errors.get(hash32)
             if err is not None:
-                import msgpack
-
-                count, next_try = msgpack.unpackb(err)
+                count, next_try, _first = unpack_error(err)
                 if next_try > now:
                     self.queue.remove(key)
                     self.queue.insert(next_try.to_bytes(8, "big") + hash32, b"")
@@ -107,11 +157,17 @@ class BlockResyncManager:
                 import msgpack
 
                 count = 0
+                first = now_msec()  # error AGE: first-failure timestamp
+                # survives retries so the ledger can tell a fresh blip
+                # from a block that has been failing for an hour
                 if err is not None:
-                    count = msgpack.unpackb(err)[0]
+                    count, _next, prev_first = unpack_error(err)
+                    if prev_first is not None:
+                        first = prev_first
                 backoff = int(expo(count, BACKOFF_MIN_MS, BACKOFF_MAX_MS))
                 self.errors.insert(
-                    hash32, msgpack.packb([count + 1, now_msec() + backoff])
+                    hash32,
+                    msgpack.packb([count + 1, now_msec() + backoff, first]),
                 )
                 self.queue.remove(key)
                 self.queue.insert(
@@ -347,9 +403,11 @@ class _ResyncWorker(Worker):
         return f"resync:{self.index}"
 
     def status(self):
+        age = self.resync.oldest_error_age_secs()
         return {
             "queue": self.resync.queue_len(),
             "errors": self.resync.errors_len(),
+            "oldest_error_secs": round(age, 1) if age is not None else None,
         }
 
     def tranquility(self) -> int | None:

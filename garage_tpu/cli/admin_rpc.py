@@ -191,18 +191,25 @@ class AdminRpcHandler:
     # --- block operations (reference src/garage/cli block subcommands) --------
 
     async def op_block_list_errors(self, args) -> Any:
-        from ..utils.serde import unpack
+        from ..block.resync import unpack_error
         from ..utils.time_util import now_msec
 
         resync = self.garage.block_manager.resync
         out = []
         for h, v in resync.errors.iter_range():
-            count, next_try = unpack(v)
+            count, next_try, first = unpack_error(v)
             out.append(
                 {
                     "hash": h.hex(),
                     "failures": count,
                     "next_try_in_secs": max(0, (next_try - now_msec()) // 1000),
+                    # error AGE: transient blip vs stuck block (None for
+                    # entries written before age tracking)
+                    "age_secs": (
+                        max(0, (now_msec() - first) // 1000)
+                        if first is not None
+                        else None
+                    ),
                 }
             )
         return out
@@ -622,6 +629,13 @@ class AdminRpcHandler:
         from ..rpc.telemetry_digest import rollup
 
         return rollup(self.garage)
+
+    async def op_durability(self, args) -> Any:
+        """Durability observatory (block/durability.py): redundancy
+        ledger + zone exposure + repair ETA — `cluster durability`."""
+        from ..block.durability import durability_response
+
+        return durability_response(self.garage)
 
     async def op_traffic(self, args) -> Any:
         """Traffic observatory (rpc/traffic.py): hot objects/buckets,

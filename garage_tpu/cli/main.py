@@ -169,6 +169,11 @@ def main(argv=None):
     chot.add_argument(
         "--top", type=int, default=10, help="hot-object rows to show"
     )
+    clu_sub.add_parser(
+        "durability",
+        help="redundancy ledger: blocks by class, zone-loss exposure, "
+        "repair ETA (block/durability.py)",
+    )
 
     ovl = sub.add_parser(
         "overload", help="overload-control plane: admission + shedding ladder"
@@ -574,6 +579,83 @@ def _render_cluster_hot(r: dict, top: int = 10) -> str:
     return out
 
 
+def _render_cluster_durability(r: dict) -> str:
+    """`cluster durability`: the redundancy ledger as an operator table
+    — cluster health fraction, per-node classes, zone-loss exposure,
+    repair ETA, layout-transition progress (model: `cluster hot`)."""
+    agg = (r.get("cluster") or {}).get("aggregate") or {}
+    local = r.get("local") or {}
+    hf = agg.get("healthyFraction")
+    eta = agg.get("repairEtaSeconds")
+    head = [
+        f"observatory\t{'enabled' if r.get('enabled') else 'DISABLED'}",
+        f"blocks\t{agg.get('blocksTotal', 0):g} classified "
+        f"({'-' if hf is None else f'{hf * 100:.1f}%'} healthy)",
+        f"classes\thealthy {agg.get('healthy', 0):g}, "
+        f"degraded {agg.get('degraded', 0):g}, "
+        f"at_risk {agg.get('atRisk', 0):g}, "
+        f"unreadable {agg.get('unreadable', 0):g}",
+        f"min redundancy\t{agg.get('minRedundancy')} "
+        "(live pieces minus k, worst block cluster-wide)",
+        f"repair eta\t{'-' if eta is None else f'{eta:.0f}s'} "
+        f"(backlog ~{agg.get('backlogBytes', 0):g} B, "
+        f"{agg.get('missingPieces', 0):g} pieces"
+        + (
+            f", {agg.get('repairEtaUnknownNodes'):g} node(s) STALLED"
+            if agg.get("repairEtaUnknownNodes")
+            else ""
+        )
+        + ")",
+    ]
+    snap = local.get("snapshot") or {}
+    lay = snap.get("layout") or {}
+    if lay:
+        head.append(
+            f"layout\tv{lay.get('version')} "
+            f"{lay.get('partitionsSynced', 0)}/{lay.get('partitions', 0)} "
+            f"partitions synced ({(lay.get('progress') or 0) * 100:.0f}%)"
+        )
+    re_ = snap.get("resyncErrors") or {}
+    if re_.get("transient") or re_.get("stuck"):
+        oldest = re_.get("oldestAgeSecs")
+        head.append(
+            f"resync errors\t{re_.get('transient', 0)} transient, "
+            f"{re_.get('stuck', 0)} stuck "
+            + (
+                f"(oldest {oldest}s)"
+                if oldest is not None
+                else "(ages unknown: pre-upgrade entries)"
+            )
+        )
+    out = format_table(head) + "\n"
+    zones = agg.get("zoneExposure") or {}
+    if zones:
+        rows = ["zone\tblocks below k if lost"]
+        for z, n in sorted(zones.items(), key=lambda kv: -kv[1]):
+            rows.append(f"{z}\t{n:g}")
+        out += "\n== zone-loss exposure ==\n" + format_table(rows) + "\n"
+    nodes = (r.get("cluster") or {}).get("nodes") or []
+    rows = ["id\tup\towned\thealthy\tdegr\tat-risk\tunread\tminr\teta\tage"]
+    for n in nodes:
+        d = n.get("durability")
+        if not isinstance(d, dict) or d.get("tot") is None:
+            rows.append(
+                f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+                "-\t-\t-\t-\t-\t-\t-\tno-ledger"
+            )
+            continue
+        eta_n = d.get("eta")
+        rows.append(
+            f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+            f"{d.get('tot', 0)}\t{d.get('h', 0)}\t{d.get('dg', 0)}\t"
+            f"{d.get('ar', 0)}\t{d.get('ur', 0)}\t{d.get('minr')}\t"
+            f"{'-' if eta_n is None else f'{eta_n:g}s'}\t"
+            f"{d.get('age')}s"
+        )
+    out += "\n== nodes ==\n" + format_table(rows)
+    return out
+
+
 async def dispatch(args, call, config) -> str | None:
     from ..utils.config import _parse_capacity
 
@@ -663,6 +745,11 @@ async def dispatch(args, call, config) -> str | None:
             if args.json:
                 return json.dumps(r, indent=2, default=repr)
             return _render_cluster_hot(r, top=args.top)
+        if args.cluster_cmd == "durability":
+            r = await call("durability")
+            if args.json:
+                return json.dumps(r, indent=2, default=repr)
+            return _render_cluster_durability(r)
         if args.cluster_cmd == "telemetry":
             return json.dumps(
                 await call("cluster-telemetry"), indent=2, default=repr
@@ -1011,10 +1098,13 @@ async def dispatch(args, call, config) -> str | None:
             errs = await call("block-list-errors")
             if jd:
                 return jd(errs)
-            rows = ["hash\tfailures\tnext try in"]
+            rows = ["hash\tfailures\tage\tnext try in"]
             for e in errs:
+                age = e.get("age_secs")
                 rows.append(
-                    f"{e['hash'][:16]}\t{e['failures']}\t{e['next_try_in_secs']}s"
+                    f"{e['hash'][:16]}\t{e['failures']}\t"
+                    f"{'-' if age is None else f'{age}s'}\t"
+                    f"{e['next_try_in_secs']}s"
                 )
             return format_table(rows)
         if bc == "info":

@@ -369,6 +369,37 @@ class Garage:
                 self.repair_params, "bytes_in_flight", max(1, int(v))
             ),
         )
+        # durability observatory (block/durability.py): always
+        # constructed — the telemetry digest and the admin endpoint read
+        # it — spawned as a worker only when [durability] enabled
+        from ..block.durability import DurabilityScanner, ScanParams
+
+        self.durability_scanner = DurabilityScanner(
+            self.block_manager,
+            params=ScanParams(
+                tranquility=config.durability.tranquility,
+                scan_batch=config.durability.scan_batch,
+                interval_secs=config.durability.interval_secs,
+                stuck_error_secs=config.durability.stuck_error_secs,
+            ),
+            planner_fn=lambda: self.repair_planner,
+        )
+        self.bg_vars.register_rw(
+            "durability-tranquility",
+            lambda: str(self.durability_scanner.params.tranquility),
+            lambda v: setattr(
+                self.durability_scanner.params, "tranquility", max(0, int(v))
+            ),
+        )
+        self.bg_vars.register_rw(
+            "durability-interval-secs",
+            lambda: str(self.durability_scanner.params.interval_secs),
+            lambda v: setattr(
+                self.durability_scanner.params,
+                "interval_secs",
+                max(0.05, float(v)),
+            ),
+        )
         # overload-control plane (api/overload.py + rpc/shedding.py):
         # the admission controller exists from construction (the S3
         # server reads it per request); the shedding controller spawns
@@ -499,6 +530,46 @@ class Garage:
         resync = self.block_manager.resync
         reg("block_resync_queue_length", (), lambda: len(resync.queue))
         reg("block_resync_errored_blocks", (), lambda: len(resync.errors))
+        # error AGE: transient blip vs stuck block (0 when the error set
+        # is empty or predates age tracking)
+        reg(
+            "block_resync_oldest_error_age_seconds", (),
+            lambda: float(resync.oldest_error_age_secs() or 0.0),
+        )
+        # durability observatory (block/durability.py): ledger classes,
+        # backlog, ETA, zone exposure, layout-sync progress.  `id` is
+        # process-unique (in-process multi-node registry sharing); fns
+        # raise before the first completed pass so samples are dropped,
+        # never fabricated.
+        from ..block.durability import DUR_CLASSES
+
+        sc = self.durability_scanner
+        gid = (("id", sc.gauge_id),)
+        for cls in DUR_CLASSES:
+            reg(
+                "durability_blocks",
+                (("class", cls),) + gid,
+                lambda c=cls: sc.published_class(c),
+            )
+        reg(
+            "durability_missing_pieces", gid,
+            lambda: sc.published_value("missingPieces"),
+        )
+        reg(
+            "durability_repair_eta_seconds", gid,
+            # float(None) raises on unknown ETA -> sample dropped
+            lambda: float(sc.repair_eta_secs()),
+        )
+        reg("durability_backlog_bytes", gid, lambda: sc.backlog_bytes())
+        reg(
+            "durability_zone_exposed_blocks", gid,
+            lambda: sc.worst_zone_exposed(),
+        )
+        reg(
+            "durability_layout_sync_fraction", gid,
+            lambda: sc.layout_sync_fraction(),
+        )
+        reg("durability_scan_age_seconds", gid, lambda: sc.scan_age_secs())
         reg(
             "block_ram_buffer_bytes", (),
             lambda: self.block_manager.buffers.used,
@@ -555,6 +626,10 @@ class Garage:
 
             self.shedder = SheddingController(self)
             self.bg.spawn(self.shedder)
+        if self.config.durability.enabled:
+            # durability observatory (block/durability.py): tranquilized
+            # rc-tree walk feeding the redundancy ledger + digest
+            self.bg.spawn(self.durability_scanner)
         # restart-safe repair plane: a plan checkpointed mid-flight by a
         # previous process resumes (ledger + cursor intact) instead of
         # rescanning the cluster

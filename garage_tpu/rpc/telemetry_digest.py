@@ -164,13 +164,22 @@ class DigestCollector:
                     breakers["sick"] += 1
 
         planner = getattr(g, "repair_planner", None)
+        planner_live = planner is not None and not planner.finished
         repair_backlog = (
             # the ledger lives on the checkpointable plan state;
             # queue_length() is the planner's own backlog accessor
-            planner.queue_length() or 0
-            if planner is not None and not planner.finished
-            else 0
+            planner.queue_length() or 0 if planner_live else 0
         )
+        # urgency breakdown (block/repair_plan.py classify buckets): the
+        # total backlog alone can't tell "10k low-urgency stripes" from
+        # "10k one-failure-from-loss stripes" — the distinction the
+        # durability observatory and `cluster top` triage on
+        urg = (
+            planner.backlog_by_urgency()
+            if planner_live
+            else {"critical": 0, "high": 0, "low": 0, "lost": 0}
+        )
+        resync_age = g.block_manager.resync.oldest_error_age_secs()
 
         from ..ops.telemetry import platforms_seen
 
@@ -195,8 +204,16 @@ class DigestCollector:
             "resync": {
                 "q": g.block_manager.resync.queue_len(),
                 "err": g.block_manager.resync.errors_len(),
+                # oldest error AGE (secs): transient blip vs stuck block
+                "age": round(resync_age, 1) if resync_age is not None else None,
             },
-            "repair": {"backlog": repair_backlog},
+            "repair": {
+                "backlog": repair_backlog,
+                "cr": urg.get("critical", 0),
+                "hi": urg.get("high", 0),
+                "lo": urg.get("low", 0),
+                "lost": urg.get("lost", 0),
+            },
             "rpc": breakers,
             "tpu": {
                 "dps": round(rates["tpu_disp"], 4),
@@ -235,6 +252,13 @@ class DigestCollector:
             sh = getattr(g, "shedder", None)
             ovl["lvl"] = sh.level if sh is not None else 0
             digest["ovl"] = ovl
+        # durability observatory (block/durability.py): redundancy-class
+        # counts, min margin, repair ETA, zone exposure, layout-sync
+        # progress — "dur" keys are additive, DIGEST_VERSION stays 1.
+        # Counts are OWNED blocks, so the rollup's sums are exact.
+        ds = getattr(g, "durability_scanner", None)
+        if ds is not None:
+            digest["dur"] = ds.digest_fields()
         self._cached, self._cached_t = digest, now
         return digest
 
@@ -557,6 +581,14 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
         ]
         return max(vals) if vals else None
 
+    def dmin(*path) -> float | None:
+        vals = [
+            v
+            for r in with_digest
+            if (v := _num(_dig(r, *path))) is not None
+        ]
+        return min(vals) if vals else None
+
     slo = _cluster_slo(garage, with_digest)
     h = garage.system.health(outlier_nodes=sorted(outliers))
     return {
@@ -575,6 +607,25 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
             "workerErrors": dsum("work", "errs"),
             "breakersOpen": dsum("rpc", "open"),
             "tpuDispatchPerSec": round(dsum("tpu", "dps"), 4),
+            # durability observatory: per-node counts are OWNED blocks,
+            # so sums are exact cluster totals; min-redundancy is the
+            # min over nodes (distance from data loss), ETA the max
+            # (the slowest node gates full redundancy)
+            "durabilityHealthy": dsum("dur", "h"),
+            "durabilityDegraded": dsum("dur", "dg"),
+            "durabilityAtRisk": dsum("dur", "ar"),
+            "durabilityUnreadable": dsum("dur", "ur"),
+            "durabilityMinRedundancy": dmin("dur", "minr"),
+            "repairEtaSecondsWorst": dmax("dur", "eta"),
+            # nodes with missing pieces but NO eta (stalled/unmeasured):
+            # dmax drops their None, so a healthy node's 0.0 would
+            # otherwise mask a repair that isn't draining at all
+            "repairEtaUnknownNodes": sum(
+                1
+                for r in with_digest
+                if (_num(_dig(r, "dur", "mp"), 0.0) or 0.0) > 0
+                and _num(_dig(r, "dur", "eta")) is None
+            ),
         },
         "outliers": outliers,
         "slo": slo,
@@ -603,8 +654,12 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
     ("cluster_node_resync_queue_length", "resync backlog", ("resync", "q")),
     ("cluster_node_resync_errored_blocks", "resync error blocks",
      ("resync", "err")),
+    ("cluster_node_resync_oldest_error_age_seconds",
+     "age of the node's oldest resync error", ("resync", "age")),
     ("cluster_node_repair_backlog", "repair-plan ledger backlog",
      ("repair", "backlog")),
+    ("cluster_node_repair_backlog_critical",
+     "repair-plan stripes one failure from loss", ("repair", "cr")),
     ("cluster_node_breakers_open", "peers behind an open breaker",
      ("rpc", "open")),
     ("cluster_node_tpu_dispatch_per_second", "TPU codec dispatch rate",
@@ -639,6 +694,35 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
      "approximate op rate of the node's hottest bucket", ("trf", "hbps")),
     ("cluster_node_traffic_zipf_skew",
      "estimated zipf exponent of the key popularity", ("trf", "zipf")),
+    # durability observatory (block/durability.py): numeric dur digest
+    # fields only — zone NAMES stay in /v1/cluster/durability JSON,
+    # never a label (metrics-lint cardinality discipline)
+    ("cluster_node_durability_blocks_total",
+     "blocks owned and classified by the node's ledger", ("dur", "tot")),
+    ("cluster_node_durability_blocks_healthy",
+     "owned blocks with all k+m pieces on live ranks", ("dur", "h")),
+    ("cluster_node_durability_blocks_degraded",
+     "owned blocks with k < live pieces < k+m", ("dur", "dg")),
+    ("cluster_node_durability_blocks_at_risk",
+     "owned blocks one failure away from loss (live == k)",
+     ("dur", "ar")),
+    ("cluster_node_durability_blocks_unreadable",
+     "owned blocks below k live pieces", ("dur", "ur")),
+    ("cluster_node_durability_missing_pieces",
+     "pieces missing across the node's owned blocks", ("dur", "mp")),
+    ("cluster_node_durability_min_redundancy",
+     "worst live-minus-k margin across owned blocks (min over nodes = "
+     "the cluster's distance from data loss)", ("dur", "minr")),
+    ("cluster_node_durability_repair_eta_seconds",
+     "estimated seconds until the repair backlog drains", ("dur", "eta")),
+    ("cluster_node_durability_backlog_bytes",
+     "estimated bytes of missing redundancy", ("dur", "bkb")),
+    ("cluster_node_durability_zone_exposed_blocks",
+     "owned blocks a single worst-zone loss would drop below k",
+     ("dur", "zx")),
+    ("cluster_node_layout_sync_fraction",
+     "fraction of partitions synced to the current layout version",
+     ("dur", "lt")),
 ]
 
 

@@ -141,6 +141,30 @@ class RepairPlanConfig:
 
 
 @dataclass
+class DurabilityConfig:
+    """Rebuild-specific: the durability observatory
+    (block/durability.py DurabilityScanner) — an incremental
+    rc-tree walk classifying every locally-owned block into redundancy
+    classes (healthy / degraded / at_risk / unreadable), deriving
+    zone-loss exposure, repair ETA and layout-transition progress.
+    `worker set durability-tranquility` / `durability-interval-secs`
+    tune the running scanner live."""
+
+    enabled: bool = True
+    # Tranquilizer pacing between scan batches (same contract as resync:
+    # sleep tranquility x the average batch duration; 0 = flat out)
+    tranquility: int = 2
+    # rc-tree keys classified per work() iteration
+    scan_batch: int = 256
+    # seconds between full ledger passes (a layout change kicks one
+    # immediately); tests tune this down
+    interval_secs: float = 60.0
+    # a resync-errored block older than this counts "stuck" rather than
+    # "transient" in the ledger (error ages, block/resync.py)
+    stuck_error_secs: float = 900.0
+
+
+@dataclass
 class OverloadConfig:
     """Rebuild-specific: the overload-control plane (api/overload.py
     admission controller + rpc/shedding.py SLO-driven shedding ladder).
@@ -283,6 +307,7 @@ class Config:
     block: BlockConfig = field(default_factory=BlockConfig)
     tpu: TpuConfig = field(default_factory=TpuConfig)
     repair: RepairPlanConfig = field(default_factory=RepairPlanConfig)
+    durability: DurabilityConfig = field(default_factory=DurabilityConfig)
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     consul_discovery: ConsulDiscoveryConfig | None = None
     kubernetes_discovery: KubernetesDiscoveryConfig | None = None
@@ -499,6 +524,8 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             cfg.tpu = TpuConfig(**_known(v, TpuConfig))
         elif k == "repair":
             cfg.repair = RepairPlanConfig(**_known(v, RepairPlanConfig))
+        elif k == "durability":
+            cfg.durability = DurabilityConfig(**_known(v, DurabilityConfig))
         elif k == "overload":
             cfg.overload = OverloadConfig(**_known(v, OverloadConfig))
         elif k == "consul_discovery":
@@ -545,6 +572,17 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         raise ValueError("traffic_topk must be >= 8")
     if float(cfg.admin.traffic_halflife_secs) <= 0:
         raise ValueError("traffic_halflife_secs must be > 0")
+    # durability observatory knobs: a zero batch can never finish a
+    # pass, a non-positive interval busy-loops full rc-tree walks
+    du = cfg.durability
+    if int(du.scan_batch) < 1:
+        raise ValueError("durability.scan_batch must be >= 1")
+    if float(du.interval_secs) <= 0:
+        raise ValueError("durability.interval_secs must be > 0")
+    if int(du.tranquility) < 0:
+        raise ValueError("durability.tranquility must be >= 0")
+    if float(du.stuck_error_secs) <= 0:
+        raise ValueError("durability.stuck_error_secs must be > 0")
     # overload knobs: refuse values that would wedge admission at load
     # time (a zero rate admits nothing forever; inverted hysteresis
     # thresholds would make the ladder oscillate by construction)

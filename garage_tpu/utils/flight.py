@@ -641,6 +641,32 @@ def detach_recorder(rec: SlowRequestRecorder) -> None:
     span_fanout.detach(rec)
 
 
+def record_event(name: str, attrs: dict, recorder=None) -> None:
+    """Append a synthetic EVENT record to the slow-request ring(s).
+
+    Not a request: no span tree, zero duration, `ok: false` so the ring
+    renderers surface it.  Used by planes that detect a state transition
+    worth an operator's attention post-hoc — e.g. the durability
+    observatory recording blocks entering `at_risk`/`unreadable`
+    (block/durability.py).  `recorder=None` fans out to every recorder
+    attached to the shared span fanout (all in-process nodes); pass one
+    explicitly for tests/ad-hoc tooling."""
+    rec = {
+        "traceId": "",
+        "name": name,
+        "event": True,
+        "start": time.time(),
+        "durationMs": 0.0,
+        "ok": False,
+        "phases": None,
+        "attrs": {k: str(v) for k, v in attrs.items()},
+        "spans": [],
+    }
+    targets = [recorder] if recorder is not None else list(span_fanout.recorders)
+    for r in targets:
+        r.records.append(rec)
+
+
 def slow_response(recorder: "SlowRequestRecorder | None") -> dict:
     """The one serialization of the slow-request state, shared by the
     admin HTTP endpoint and the admin RPC op (so key casing cannot

@@ -99,6 +99,16 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         ("repair_blocks_per_s", ">=", 20.0, "repair-plane throughput"),
         ("repaired", ">=", 10000, "full 10k-block population repaired"),
         ("mesh_engaged", ">=", 1, "TPU/mesh dispatch actually engaged"),
+        # ISSUE 14: the durability ledger's operator-visible "redundancy
+        # restored" moment (repair elapsed + the confirming scan pass).
+        # 20 blocks/s over 10k blocks is 500 s; 600 leaves scan headroom
+        # while still tripping if the repair plane or the ledger's
+        # local-missing accounting regresses.  (measured ~60 s on this
+        # box; a >= presence floor doubles as the reshaped-artifact gate)
+        ("time_to_redundancy_restored_s", "<=", 600.0,
+         "ledger-confirmed time to full redundancy"),
+        ("time_to_redundancy_restored_s", ">=", 0.01,
+         "time-to-redundancy-restored banked from the ledger"),
     ],
     "BENCH_r05.json": [
         # 6.2 GB/s CPU-fallback encode = vs_baseline 0.62 (10 GB/s
