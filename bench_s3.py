@@ -56,6 +56,80 @@ def _pct(xs: list[float], q: float) -> float:
     return xs[min(len(xs) - 1, int(q * len(xs)))]
 
 
+def _meta_summary(garages) -> dict:
+    """Quorum shape of the metadata plane vs the block stripe (ISSUE
+    15): the artifact datum proving table quorums stay O(1) in stripe
+    width — `table_nodes` is the meta-ring fan, `block_nodes` the
+    stripe fan of the same partition."""
+    from garage_tpu.table.replication import partition_first_hash
+
+    rep = garages[0].object_table.replication
+    h = garages[0].layout_manager.history
+    fh = partition_first_hash(0)
+    rf = rep.effective_rf() if hasattr(rep, "effective_rf") else None
+    return {
+        "rf": rf,
+        "read_q": rep.read_quorum(),
+        "write_q": rep.write_quorum(),
+        "table_nodes": len(rep.read_nodes(fh)),
+        "block_nodes": len(h.read_nodes_of(fh)),
+    }
+
+
+def _coalesce_counts() -> dict:
+    """Cumulative insert-coalescer counters (table/coalesce.py) —
+    sampled before/after the measured mix, the delta shows how many
+    table RPCs the linger window saved."""
+    from garage_tpu.utils.metrics import registry as reg
+
+    merged = reg.family_merge("table_coalesce_batch_entries")
+    return {
+        "dispatches": int(merged[0]) if merged else 0,
+        "entries": int(merged[1]) if merged else 0,
+        "coalesced_entries": int(
+            reg.counter_family_sum("table_coalesce_coalesced_total")
+        ),
+    }
+
+
+def _coalesce_delta(before: dict, after: dict) -> dict:
+    out = {k: after[k] - before[k] for k in before}
+    out["avg_batch"] = (
+        round(out["entries"] / out["dispatches"], 2)
+        if out["dispatches"]
+        else None
+    )
+    return out
+
+
+def _phase_share(phases: dict | None, phase: str) -> float | None:
+    """criticalPathShare: this phase's fraction of the ATTRIBUTED time."""
+    if not phases:
+        return None
+    st = (phases.get("phases") or {}).get(phase)
+    return st["share"] if st else None
+
+
+def _phase_client_share(
+    phases: dict | None, phase: str, client_p50_s: float | None
+) -> float | None:
+    """Fraction of the CLIENT-side GET p50 spent in this phase
+    (phase p50 / client wall p50).  The gated index_read datum uses
+    this, not criticalPathShare: once the hot-block cache serves the
+    data plane in ~zero time, the critical-path denominator collapses
+    to metadata+auth and the share saturates no matter how fast
+    index_read gets.  The client ratio measures what the user feels,
+    and — numerator and denominator carrying the same box-load noise —
+    is stable across runs (0.42–0.43 over three banking runs vs
+    0.54 before the meta ring)."""
+    if not phases or not client_p50_s:
+        return None
+    st = (phases.get("phases") or {}).get(phase)
+    if not st:
+        return None
+    return round(st["p50_ms"] / (client_p50_s * 1000.0), 4)
+
+
 def _phase_summary(snap: dict | None) -> dict | None:
     """Compact per-phase stats for the artifact from a latency-X-ray
     snapshot op entry (utils/latency.py): the future pipeline PR must be
@@ -65,6 +139,7 @@ def _phase_summary(snap: dict | None) -> dict | None:
     return {
         "coverage": snap["coverage"],
         "overlap_efficiency": snap["overlapEfficiency"],
+        "wall_p50_ms": snap["wallMs"]["p50"],
         "wall_p99_ms": snap["wallMs"]["p99"],
         "phases": {
             ph: {"p50_ms": st["p50"], "p99_ms": st["p99"],
@@ -95,6 +170,7 @@ async def run_cluster(
             await client.put_object("bench", f"warm{i}", body)
         # the server-side phase waterfall for THIS workload only
         latency_mod.aggregator.reset()
+        co0 = _coalesce_counts()
         # exact client-side wall times: the server-side latency histograms
         # (utils/metrics.py) use log2 buckets, which quantize a p99 ratio
         # to powers of two — too coarse to check a 1.2x bound honestly
@@ -120,6 +196,11 @@ async def run_cluster(
             "phases": _phase_summary(
                 latency_mod.aggregator.snapshot().get("put")
             ),
+            # metadata-plane shape + coalescer work (ISSUE 15)
+            "meta": {
+                **_meta_summary(garages),
+                "coalesce": _coalesce_delta(co0, _coalesce_counts()),
+            },
         }
     finally:
         await stop_cluster(garages, [s3], [client])
@@ -245,6 +326,7 @@ async def run_read_heavy_cluster(
         latency_mod.aggregator.reset()
         traffic_mod.observatory.reset()
         rp0 = _read_path_counts()
+        co0 = _coalesce_counts()
         get_times: list[float] = []
         put_times: list[float] = []
 
@@ -288,6 +370,11 @@ async def run_read_heavy_cluster(
             "phases": _phase_summary(
                 latency_mod.aggregator.snapshot().get("get")
             ),
+            # metadata-plane shape + coalescer work (ISSUE 15)
+            "meta": {
+                **_meta_summary(garages),
+                "coalesce": _coalesce_delta(co0, _coalesce_counts()),
+            },
             "observatory": {
                 "topk_precision": round(len(set(got) & want) / 10, 2),
                 "top_objects": snap["hotObjects"][:5],
@@ -498,6 +585,32 @@ async def main() -> None:
                     "ec": ec["read_path"],
                 },
                 "phases": {"replica": rep["phases"], "ec": ec["phases"]},
+                # metadata plane (ISSUE 15): quorum node counts + the
+                # index_read share of the EC GET waterfall — the datum
+                # the meta-ring decoupling had to push down (~0.80
+                # before), floor-gated by script/bench_diff.py
+                "meta": {
+                    **ec["meta"],
+                    # share of the EC GET client p50 spent reading
+                    # metadata (the gated datum; was 0.54 before the
+                    # meta ring: index_read p50 102 ms of 190 ms)
+                    "index_read_share": _phase_client_share(
+                        ec["phases"], "index_read", ec["get_p50"]
+                    ),
+                    # continuity with the pre-meta-ring artifact's
+                    # criticalPathShare (~0.82 banked): saturates on a
+                    # cache-served read path, see _phase_wall_share
+                    "index_read_critical_path_share": _phase_share(
+                        ec["phases"], "index_read"
+                    ),
+                    "index_read_p50_ms": (
+                        (ec["phases"].get("phases") or {}).get(
+                            "index_read", {}
+                        ).get("p50_ms")
+                        if ec["phases"]
+                        else None
+                    ),
+                },
                 # what the observatory reported for the EC run — the
                 # precision datum doubles as an end-to-end check that
                 # the measurement plane sees the workload it will tune
@@ -537,7 +650,7 @@ async def main() -> None:
         return {
             k_: round(v * 1000, 2) if v else None
             for k_, v in res.items()
-            if k_ != "phases"
+            if k_ in ("put_p50", "put_p99", "get_p99")
         }
 
     async def one_level(concurrency: int) -> dict:
@@ -566,6 +679,15 @@ async def main() -> None:
             "ec_ms": _ms_of(ec),
             "replica_phases": rep["phases"],
             "ec_phases": ec["phases"],
+            # metadata plane (ISSUE 15): quorum node counts + the
+            # meta_commit share of the EC PUT waterfall + what the
+            # insert coalescer saved at this concurrency level
+            "meta": {
+                **ec["meta"],
+                "meta_commit_share": _phase_share(
+                    ec["phases"], "meta_commit"
+                ),
+            },
         }
 
     base_detail = {
@@ -590,7 +712,14 @@ async def main() -> None:
             "value": ratio,
             "unit": f"ratio @ {max(levels)} clients",
             "vs_baseline": round(1.5 / ratio, 3) if ratio else None,
-            "detail": {**base_detail, "levels": per_level},
+            # headline meta shape = the HIGHEST concurrency level's
+            # (same cluster geometry at every level; the coalescer
+            # numbers are where the levels differ)
+            "detail": {
+                **base_detail,
+                "meta": top["meta"],
+                "levels": per_level,
+            },
         }
     else:
         lvl = await one_level(1)
@@ -601,6 +730,7 @@ async def main() -> None:
             "vs_baseline": round(1.2 / lvl["ratio"], 3) if lvl["ratio"] else None,
             "detail": {
                 **base_detail,
+                "meta": lvl["meta"],
                 "replica_ms": lvl["replica_ms"],
                 "ec_ms": lvl["ec_ms"],
                 # per-phase attribution (utils/latency.py): where the EC

@@ -119,7 +119,7 @@ async def handle_upload_part(garage, bucket_id, key, request, ctx=None):
     from .objects import stream_blocks
 
     try:
-        md5_hex, sha, total = await stream_blocks(
+        md5_hex, sha, total, _blocks = await stream_blocks(
             garage, vid, bucket_id, key, part_number,
             request.content, garage.config.block_size,
             transform=enc.encrypt_block if enc else None, extra_hash=cks,
@@ -229,7 +229,7 @@ async def handle_upload_part_copy(
     vid = gen_uuid()
     await garage.version_table.insert(Version(vid, bucket_id, key))
     try:
-        md5_hex, _sha, total = await stream_blocks(
+        md5_hex, _sha, total, _blocks = await stream_blocks(
             garage, vid, bucket_id, key, part_number,
             body, garage.config.block_size,
             transform=dst_enc.encrypt_block if dst_enc else None,
@@ -353,6 +353,10 @@ async def handle_complete_multipart_upload(garage, bucket_id, key, request, ctx=
         {"t": "first_block", "vid": final.uuid, "meta": meta},
     )
     await garage.object_table.insert(Object(bucket_id, key, [ov]))
+    # warm the metadata fast path with the assembled final version (the
+    # exact row quorum-committed above) — the next GET skips the
+    # version quorum read
+    garage.version_cache.put(final.uuid, final)
     # tombstone part versions (incl. stale re-uploads) and close the mpu
     await _gather_chunked(
         [

@@ -28,6 +28,7 @@ from ...model.s3.block_ref_table import BlockRef
 from ...model.s3.object_table import Object, ObjectVersion
 from ...model.s3.version_table import Version
 from ...utils.aio import reap
+from ...utils.crdt import CrdtMap
 from ...utils.data import blake2sum, gen_uuid
 from ...utils.latency import mark_op, phase_span
 from ...utils.time_util import now_msec
@@ -172,6 +173,9 @@ async def stream_blocks(
     offset = 0
     offload_min = garage.config.block.cpu_offload_min_bytes
     inflight: set[asyncio.Task] = set()
+    # every committed block entry, for the caller's version-cache warm
+    # (the union of these IS the quorum-committed version row)
+    committed_blocks: list[tuple[int, int, bytes, int]] = []
 
     async def put_meta(h: bytes, stored_len: int, block_offset: int):
         with phase_span("meta_commit"):
@@ -184,6 +188,9 @@ async def stream_blocks(
             await asyncio.gather(
                 garage.version_table.insert(v),
                 garage.block_ref_table.insert(BlockRef(h, vid)),
+            )
+            committed_blocks.append(
+                (part_number, block_offset, h, stored_len)
             )
 
     async def put_one(block: bytes, block_offset: int):
@@ -255,7 +262,7 @@ async def stream_blocks(
         # version table while the caller tombstoned it
         await reap(inflight, log=logger, what="put-block task")
         raise
-    return md5.hexdigest(), sha, total
+    return md5.hexdigest(), sha, total, committed_blocks
 
 
 async def handle_put_object(
@@ -322,8 +329,9 @@ async def handle_put_object(
         )
     buf_first = first
 
+    in_indeterminate_zone = False
     try:
-        md5_hex, sha, total = await stream_blocks(
+        md5_hex, sha, total, committed_blocks = await stream_blocks(
             garage, vid, bucket_id, key, 0, body, block_size, first=buf_first,
             transform=enc.encrypt_block if enc else None, extra_hash=cks,
         )
@@ -345,13 +353,43 @@ async def handle_put_object(
             vid, ts, "complete",
             {"t": "first_block", "vid": vid, "meta": meta},
         )
+        # INDETERMINATE ZONE — do not abort past this point.  A quorum
+        # timeout on the final insert can leave the "complete" row on a
+        # MINORITY of nodes: their CRDT prune then drops the previous
+        # version and cascades its version-table deletion.  If we then
+        # inserted "aborted" (which beats "complete" in the state
+        # order), the new version un-completes everywhere while the old
+        # one's data is already tombstoned — the last ACKED write 404s
+        # ("version data missing") with nothing left to heal it.  The
+        # jepsen combined-nemeses flake under CPU load was exactly this
+        # (pinned repro: tests/test_model.py
+        # test_put_overwrite_indeterminate_complete_not_aborted).  At
+        # this point every block and version row is quorum-committed, so
+        # the safe failure mode is to LEAVE the uploading row (pruned by
+        # the next successful overwrite) and return 500 — at-least-once,
+        # never un-complete.  See doc/metadata-replication.md.
+        in_indeterminate_zone = True
         with phase_span("meta_commit"):
             await garage.object_table.insert(Object(bucket_id, key, [final]))
+        # warm the metadata fast path: the union of the per-block rows
+        # this request quorum-committed IS the version row a GET would
+        # read — the next GET of this key skips the version quorum read.
+        # One-shot CrdtMap construction (single sort): per-block put()
+        # re-merges the whole map each time, O(n^2 log n) on a
+        # many-thousand-block PUT, synchronously on the event loop.
+        full_v = Version(vid, bucket_id, key)
+        full_v.blocks = CrdtMap(
+            [([pn, off], {"h": h, "s": sz})
+             for pn, off, h, sz in committed_blocks]
+        )
+        garage.version_cache.put(vid, full_v)
         resp_headers = {"ETag": f'"{etag}"'}
         if enc is not None:
             resp_headers.update(enc.response_headers())
         return web.Response(status=200, headers=resp_headers)
     except BaseException:
+        if in_indeterminate_zone:
+            raise
         # InterruptedCleanup (reference put.rs:217-223): mark aborted so
         # the cascade reclaims stored blocks
         aborted = ObjectVersion(vid, ts, "aborted", {"t": "first_block", "vid": vid})
@@ -612,6 +650,36 @@ def _parse_part_number(request) -> int | None:
     return pn
 
 
+async def _escalate_version_missing(garage, bucket_id, key, stale):
+    """The object row resolved a version whose version-table row is
+    tombstoned or absent.  The legitimate cause (pinned by
+    tests/test_put_abort_race.py, the jepsen `404 version data missing`
+    lead): an indeterminate overwrite landed its "complete" row on a
+    minority of object replicas, and that minority's CRDT prune cascade
+    tombstoned OUR version's row at quorum speed — so quorum reads that
+    skip the minority replica keep resolving a version with no data.
+    Recovery: merge the object row from EVERY reachable replica
+    (read-repairing the merge back), and serve the newer version it
+    surfaces.  If the full merge still resolves the same version, the
+    data is genuinely gone — 404."""
+    with phase_span("index_read"):
+        obj = await garage.object_table.get_merged_all(
+            bucket_id, key.encode()
+        )
+    version = _pick_version(obj)
+    if version.data.get("t") == "inline":
+        return version, None
+    if bytes(version.data.get("vid", b"")) == bytes(
+        stale.data.get("vid", b"")
+    ):
+        raise NoSuchKey("version data missing")
+    with phase_span("index_read"):
+        ver = await garage.version_table.get(version.data["vid"], b"")
+    if ver is None or ver.deleted.get():
+        raise NoSuchKey("version data missing")
+    return version, ver
+
+
 async def handle_get_object(
     garage,
     bucket_id: bytes,
@@ -623,9 +691,37 @@ async def handle_get_object(
     from .encryption import EncryptionParams, check_match
 
     mark_op("head" if head_only else "get")
+    part_number = _parse_part_number(request)
     with phase_span("index_read"):
         obj = await garage.object_table.get(bucket_id, key.encode())
     version = _pick_version(obj)
+    blocks = None
+    # plain HEAD never needs the block list — don't pay a version-table
+    # quorum read on that hot path
+    if version.data.get("t") != "inline" and (
+        part_number is not None or not head_only
+    ):
+        # metadata fast path: a visible complete version's row is
+        # immutable (VersionRowCache safety argument), so repeat GETs
+        # skip the second quorum read entirely
+        vid = bytes(version.data["vid"])
+        ver = garage.version_cache.get(vid)
+        if ver is None:
+            with phase_span("index_read"):
+                ver = await garage.version_table.get(vid, b"")
+            if ver is not None and not ver.deleted.get():
+                garage.version_cache.put(vid, ver)
+        if ver is None or ver.deleted.get():
+            # escalate before 404ing (tests/test_put_abort_race.py): a
+            # newer complete overwrite may exist on a MINORITY of object
+            # replicas, its prune cascade having tombstoned OUR version
+            # at quorum speed while the staggered quorum read above
+            # never consulted that replica
+            version, ver = await _escalate_version_missing(
+                garage, bucket_id, key, version
+            )
+        if ver is not None:
+            blocks = ver.sorted_blocks()
     _check_conditionals(request, version)
     meta = version.data.get("meta", {})
     enc_params = EncryptionParams.from_headers(request.headers)
@@ -650,17 +746,7 @@ async def handle_get_object(
             if qname in request.query:
                 headers[hname] = request.query[qname]
 
-    part_number = _parse_part_number(request)
     is_inline = version.data.get("t") == "inline"
-    blocks = None
-    # plain HEAD never needs the block list — don't pay a version-table
-    # quorum read on that hot path
-    if not is_inline and (part_number is not None or not head_only):
-        with phase_span("index_read"):
-            ver = await garage.version_table.get(version.data["vid"], b"")
-        if ver is None or ver.deleted.get():
-            raise NoSuchKey("version data missing")
-        blocks = ver.sorted_blocks()
 
     status = 200
     if part_number is not None:

@@ -467,6 +467,21 @@ def _render_cluster_top(r: dict) -> str:
             f"p99 {slo['latencyP99']['budgetRemaining'] * 100:.1f}% "
             f"(burn {slo['latencyP99']['burnRate']:.2f})"
         )
+    # metadata plane (ISSUE 15): the answering node's effective meta
+    # quorums; per-node disagreement is flagged META-RF! in the rows
+    self_meta = next(
+        (
+            (n.get("digest") or {}).get("meta")
+            for n in r.get("nodes", [])
+            if n.get("isSelf") and (n.get("digest") or {}).get("meta")
+        ),
+        None,
+    )
+    if self_meta:
+        head.append(
+            f"meta quorums\trf {self_meta.get('rf')} "
+            f"(read {self_meta.get('rq')} / write {self_meta.get('wq')})"
+        )
     out = format_table(head) + "\n\n"
     rows = [
         "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry\thot\tflags"
@@ -491,6 +506,11 @@ def _render_cluster_top(r: dict) -> str:
         # transient failed leg must not mark a recovered node forever
         if cn.get("ok") == 0:
             flags.append("CANARY-FAIL")
+        # a node whose effective meta RF disagrees with this node's is
+        # misconfigured (or mid-rollout): its table quorums won't match
+        nm = d.get("meta")
+        if self_meta and nm and nm.get("rf") != self_meta.get("rf"):
+            flags.append(f"META-RF={nm.get('rf')}!")
         # canary column: probe p99 + cumulative failures, "-" when the
         # node runs no prober (or hasn't probed yet)
         cnry = (

@@ -20,7 +20,11 @@ from ..rpc.layout.manager import LayoutManager, PersistedLayout
 from ..rpc.replication_mode import ReplicationMode
 from ..rpc.rpc_helper import RpcHelper
 from ..rpc.system import PersistedPeers, System
-from ..table.replication import TableFullReplication, TableShardedReplication
+from ..table.replication import (
+    TableFullReplication,
+    TableMetaReplication,
+    TableStripeSyncedReplication,
+)
 from ..table.table import Table
 from ..utils.background import BackgroundRunner
 from ..utils.config import Config
@@ -126,6 +130,15 @@ class Garage:
         self.replication_mode = ReplicationMode(
             config.replication_factor, config.consistency_mode
         )
+        # the SECOND quorum tuple (ISSUE 15): metadata tables replicate
+        # at their own factor — O(1) in EC stripe width — on the meta
+        # ring (table/replication.py TableMetaReplication).  Effective
+        # factor is min(meta rf, layout rf); config load validated an
+        # explicit meta rf against the cluster's minimum size.
+        self.meta_replication_mode = ReplicationMode(
+            min(config.meta.replication_factor, config.replication_factor),
+            config.consistency_mode,
+        )
         self.layout_manager = LayoutManager(
             self.node_id,
             config.replication_factor,
@@ -196,18 +209,33 @@ class Garage:
             block_config=config.block,
         )
 
-        # tables, wired with their reactive cross-links
-        sharded = TableShardedReplication(self.system)
+        # tables, wired with their reactive cross-links.  Sharded model
+        # tables place entries on the META ring (first meta_rf distinct
+        # nodes of the partition's node list) — block placement alone
+        # spans the full stripe.
+        sharded = TableMetaReplication(self.system, self.meta_replication_mode)
+        # block_ref: same meta-ring quorums, but anti-entropy spans the
+        # full stripe — its updated() hook feeds every piece holder's rc
+        # tree (resync/scrub/GC/durability all walk it locally)
+        ref_sharded = TableStripeSyncedReplication(
+            self.system, self.meta_replication_mode
+        )
         fullcopy = TableFullReplication(self.system)
 
         self.block_ref_schema = BlockRefTable(self.block_manager)
         self.block_ref_table = Table(
-            self.system, self.helper_rpc, self.db, self.block_ref_schema, sharded
+            self.system, self.helper_rpc, self.db, self.block_ref_schema,
+            ref_sharded,
         )
         self.version_schema = VersionTable(self.block_ref_table)
         self.version_table = Table(
             self.system, self.helper_rpc, self.db, self.version_schema, sharded
         )
+        # metadata fast path (ISSUE 15): per-node cache of complete
+        # versions' rows — repeat GETs skip the version quorum read
+        from .s3.version_table import VersionRowCache
+
+        self.version_cache = VersionRowCache(config.meta.version_cache_entries)
         self.object_schema = ObjectTable(self.version_table)
         self.object_table = Table(
             self.system, self.helper_rpc, self.db, self.object_schema, sharded
@@ -259,6 +287,16 @@ class Garage:
             self.bucket_alias_table,
             self.key_table,
         ]
+        # coalesced table write path ([meta] coalesce_*): the sharded
+        # (meta-ring) tables are the hot commit path — object/version/
+        # blockref rows from concurrent requests share RPCs
+        if config.meta.coalesce_enabled:
+            for t in self.tables:
+                if isinstance(t.replication, TableMetaReplication):
+                    t.enable_coalescing(
+                        linger_msec=config.meta.coalesce_linger_msec,
+                        max_entries=config.meta.coalesce_max_entries,
+                    )
 
         from .helper import GarageHelper
         from .k2v.rpc import K2VRpcHandler
@@ -343,6 +381,39 @@ class Garage:
             "sync-interval-secs",
             lambda: str(self.tables[0].syncer.anti_entropy_interval),
             _set_sync_interval,
+        )
+
+        # table insert coalescer ([meta] knobs): live-tuned on every
+        # enabled table — the flusher reads them each flush cycle
+        def _coalescers():
+            cs = [t.coalescer for t in self.tables if t.coalescer is not None]
+            if not cs:
+                raise ValueError("insert coalescing not enabled ([meta])")
+            return cs
+
+        def _set_coalesce_linger(v: str) -> None:
+            msec = float(v)
+            if msec < 0:
+                raise ValueError("meta-coalesce-linger-msec must be >= 0")
+            for c in _coalescers():
+                c.linger_msec = msec
+
+        def _set_coalesce_max(v: str) -> None:
+            n = int(v)
+            if n < 1:
+                raise ValueError("meta-coalesce-max-entries must be >= 1")
+            for c in _coalescers():
+                c.max_entries = n
+
+        self.bg_vars.register_rw(
+            "meta-coalesce-linger-msec",
+            lambda: str(_coalescers()[0].linger_msec),
+            _set_coalesce_linger,
+        )
+        self.bg_vars.register_rw(
+            "meta-coalesce-max-entries",
+            lambda: str(_coalescers()[0].max_entries),
+            _set_coalesce_max,
         )
 
         # repair plane (block/repair_plan.py): knob object shared with a
@@ -759,6 +830,10 @@ class Garage:
             traffic.disable()
             self._traffic_enabled = False
         await self.bg.shutdown()
+        # after bg.shutdown(): the insert-queue workers are cancelled,
+        # nothing new enters the coalescers
+        for t in self.tables:
+            await t.close()
         await self.block_manager.close()
         if self.canary is not None:
             # after bg.shutdown(): the worker is cancelled, nothing is

@@ -65,6 +65,50 @@ class Version:
         ]
 
 
+class VersionRowCache:
+    """Per-node LRU of COMPLETE versions' rows, keyed by version uuid
+    (ISSUE 15 metadata fast path).  Safety argument: a GET only looks
+    up vids its quorum-fresh OBJECT row declares complete-and-visible,
+    and such a version's block list is immutable — every block entry
+    was quorum-committed before the complete object row was written
+    (api/s3/objects.py, api/s3/multipart.py), and the row can only be
+    tombstoned after the version stops being visible (the prune
+    cascade), at which point no fresh object row resolves it.  So a
+    cache hit can never serve a block list that differs from what a
+    quorum read would return for a visible vid.  Overwrites/deletes
+    need no invalidation (the object row gates visibility); the only
+    consumer-side fallback is the escalation path, which bypasses the
+    cache by construction.  Entry-bounded, per node — NEVER a process
+    singleton (in-process multi-node tests)."""
+
+    def __init__(self, max_entries: int = 1024):
+        from collections import OrderedDict
+
+        self.max_entries = int(max_entries)
+        self._d: "OrderedDict[bytes, Version]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, vid: bytes) -> "Version | None":
+        if self.max_entries <= 0:
+            return None
+        v = self._d.get(bytes(vid))
+        if v is None:
+            self.misses += 1
+            return None
+        self._d.move_to_end(bytes(vid))
+        self.hits += 1
+        return v
+
+    def put(self, vid: bytes, ver: "Version") -> None:
+        if self.max_entries <= 0 or ver.deleted.get():
+            return
+        self._d[bytes(vid)] = ver
+        self._d.move_to_end(bytes(vid))
+        while len(self._d) > self.max_entries:
+            self._d.popitem(last=False)
+
+
 class VersionTable(TableSchema):
     table_name = "version"
 

@@ -259,6 +259,18 @@ class DigestCollector:
         ds = getattr(g, "durability_scanner", None)
         if ds is not None:
             digest["dur"] = ds.digest_fields()
+        # metadata plane (ISSUE 15): EFFECTIVE meta replication factor +
+        # quorum sizes of the sharded tables, so a misconfigured meta RF
+        # on any node is visible from every node ("meta" keys are
+        # additive, DIGEST_VERSION stays 1).  Read from the live table
+        # replication (not the config) so layout-driven fallback shows.
+        rep = getattr(getattr(g, "object_table", None), "replication", None)
+        if rep is not None and hasattr(rep, "effective_rf"):
+            digest["meta"] = {
+                "rf": int(rep.effective_rf()),
+                "rq": int(rep.read_quorum()),
+                "wq": int(rep.write_quorum()),
+            }
         self._cached, self._cached_t = digest, now
         return digest
 
@@ -723,6 +735,15 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
     ("cluster_node_layout_sync_fraction",
      "fraction of partitions synced to the current layout version",
      ("dur", "lt")),
+    # metadata plane (ISSUE 15): effective table replication factor +
+    # quorum sizes — a node whose meta RF disagrees with the cluster
+    # stands out on one federated scrape
+    ("cluster_node_meta_replication_factor",
+     "effective metadata-table replication factor", ("meta", "rf")),
+    ("cluster_node_meta_read_quorum",
+     "metadata-table read quorum", ("meta", "rq")),
+    ("cluster_node_meta_write_quorum",
+     "metadata-table write quorum", ("meta", "wq")),
 ]
 
 

@@ -72,6 +72,34 @@ class MerkleUpdater:
         """Apply one merkle_todo item (value_hash = b'' means deleted)."""
         self.update_batch([(key, value_hash)])
 
+    def drain_batch(self, items: list[tuple[bytes, bytes]]) -> None:
+        """update_batch + supersession-checked todo clearing in the SAME
+        transaction (ISSUE 15): the worker used to commit twice per
+        batch — once to apply, once to clear — and on the sqlite engine
+        the per-commit cost (WAL frame + journal round-trip) is the
+        dominant term once the trie walk itself is batched.  Clearing
+        inside the apply transaction halves the commits; the
+        supersession check (only remove a todo whose value is still the
+        one we applied) keeps the contract that a concurrent
+        update_entry's newer todo survives the drain."""
+
+        def txf(tx: Tx):
+            self._apply_in_tx(tx, items)
+            todo = self.data.merkle_todo
+            for key, value_hash in items:
+                if tx.get(todo, key) == value_hash:
+                    tx.remove(todo, key)
+            return None
+
+        self.data.db.transaction(txf)
+
+    def _apply_in_tx(self, tx: Tx, items: list[tuple[bytes, bytes]]) -> None:
+        ctx = _BatchCtx(self, tx)
+        for key, value_hash in items:
+            partition = self.data.replication.partition_of(key[:32])
+            ctx.apply(partition, b"", key, value_hash or None)
+        ctx.flush()
+
     def update_batch(self, items: list[tuple[bytes, bytes]]) -> None:
         """Apply a batch of todo items in ONE transaction, hashing each
         touched node ONCE at the end.
@@ -88,11 +116,7 @@ class MerkleUpdater:
         ~135 hashes instead of ~4200."""
 
         def txf(tx: Tx):
-            ctx = _BatchCtx(self, tx)
-            for key, value_hash in items:
-                partition = self.data.replication.partition_of(key[:32])
-                ctx.apply(partition, b"", key, value_hash or None)
-            ctx.flush()
+            self._apply_in_tx(tx, items)
             return None
 
         self.data.db.transaction(txf)
@@ -267,22 +291,17 @@ class MerkleWorker(Worker):
     def status(self):
         return {"todo": len(self.data.merkle_todo)}
 
+    BATCH = 256  # todo items drained per transaction (one trie flush)
+
     async def work(self) -> WorkerState:
         batch: list[tuple[bytes, bytes]] = []
         for key, vhash in self.data.merkle_todo.iter_range():
             batch.append((key, vhash))
-            if len(batch) >= 100:
+            if len(batch) >= self.BATCH:
                 break
         if not batch:
             return WorkerState.IDLE
-        self.updater.update_batch(batch)
-        todo = self.data.merkle_todo
-
-        def clear(tx):
-            # only clear todos that weren't superseded while we applied
-            for key, vhash in batch:
-                if tx.get(todo, key) == vhash:
-                    tx.remove(todo, key)
-
-        self.data.db.transaction(clear)
+        # one transaction: structural batch apply, single bottom-up
+        # hash flush, supersession-checked todo clear
+        self.updater.drain_batch(batch)
         return WorkerState.BUSY

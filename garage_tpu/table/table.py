@@ -12,12 +12,14 @@ import logging
 from typing import Any
 
 from ..db import Db
-from ..net.message import PRIO_NORMAL, Req, Resp
+from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL, Req, Resp
 from ..rpc.rpc_helper import RpcHelper
 from ..rpc.system import System
 from ..utils.background import BackgroundRunner, spawn
+from ..utils.error import Quorum
 from ..utils.metrics import registry
 from ..utils.serde import pack
+from .coalesce import InsertCoalescer
 from .data import TableData
 from .gc import TableGc
 from .merkle import MerkleUpdater, MerkleWorker
@@ -48,9 +50,25 @@ class Table:
         self.endpoint.set_handler(self._handle)
         self.syncer = TableSyncer(self)
         self.gc = TableGc(self)
+        # cross-caller insert coalescing (ISSUE 15, table/coalesce.py):
+        # None = direct per-call quorum writes.  The composition root
+        # enables it from `[meta] coalesce_*` via enable_coalescing().
+        self.coalescer: InsertCoalescer | None = None
         # per-table op metrics (reference src/table/metrics.rs:
         # table_get/put_request_counter+duration, internal update counter)
         self._mlbl = (("table_name", schema.table_name),)
+
+    def enable_coalescing(
+        self, *, linger_msec: float = 1.0, max_entries: int = 256
+    ) -> InsertCoalescer:
+        self.coalescer = InsertCoalescer(
+            self, linger_msec=linger_msec, max_entries=max_entries
+        )
+        return self.coalescer
+
+    async def close(self) -> None:
+        if self.coalescer is not None:
+            await self.coalescer.close()
 
     def spawn_workers(self, bg: BackgroundRunner) -> None:
         bg.spawn(MerkleWorker(self.merkle))
@@ -74,7 +92,9 @@ class Table:
                 await self._insert_many(entries)
 
     async def _insert_many(self, entries: list) -> None:
-        by_sets: dict[bytes, tuple[list[list[bytes]], list[bytes]]] = {}
+        by_sets: dict[
+            bytes, tuple[list[list[bytes]], list[bytes], set[bytes]]
+        ] = {}
         for e in entries:
             pk = self.schema.entry_partition_key(e)
             h = self.schema.partition_hash(pk)
@@ -85,15 +105,47 @@ class Table:
             # their sets are identical
             key = pack([sorted(s) for s in write_sets])
             if key not in by_sets:
-                by_sets[key] = (write_sets, [])
+                by_sets[key] = (write_sets, [], set())
             by_sets[key][1].append(v)
-        for write_sets, values in by_sets.values():
+            # non-quorum stripe holders (block_ref only): best-effort
+            # background copies so their rc trees see the block promptly
+            by_sets[key][2].update(self.replication.background_nodes(h))
+        if self.coalescer is not None:
+            # cross-caller path: same-destination groups from concurrent
+            # insert_many calls share one ["U", values] RPC per node
+            await self.coalescer.submit(
+                [
+                    (k, ws, vals, extra)
+                    for k, (ws, vals, extra) in by_sets.items()
+                ]
+            )
+            return
+        for write_sets, values, extra in by_sets.values():
             await self.helper.try_write_many_sets(
                 self.endpoint,
                 write_sets,
                 ["U", values],
                 quorum=self.replication.write_quorum(),
             )
+            self.replicate_background(extra, values)
+
+    def replicate_background(
+        self, nodes: set[bytes] | list[bytes], values: list[bytes]
+    ) -> None:
+        """Fire-and-forget ["U", values] to non-quorum storage nodes
+        (TableReplication.background_nodes).  call_many returns per-node
+        exceptions as data, so a dead holder costs nothing; anti-entropy
+        repairs whatever these misses leave behind."""
+        if not nodes:
+            return
+        registry.incr(
+            "table_background_replicate_total", self._mlbl, by=len(nodes)
+        )
+        spawn(
+            self.helper.call_many(
+                self.endpoint, list(nodes), ["U", values], prio=PRIO_BACKGROUND
+            )
+        )
 
     def queue_insert(self, entry, tx=None) -> None:
         """Asynchronous local insert (reference table/queue.rs): cheap,
@@ -110,12 +162,25 @@ class Table:
             with registry.timer("table_get_request_duration", self._mlbl):
                 return await self._get(pk, sk)
 
+    def _race_reads(self, nodes: list[bytes], quorum: int) -> bool:
+        """Meta-ring reads (3 candidates, quorum 2) RACE the whole
+        ring: the surplus request is one tiny frame, and the quorum
+        completes on the FASTEST repliers instead of the ones the
+        preference order happened to pick — a straight latency cut on
+        the index_read path.  Wide candidate sets keep the staggered
+        probe, which exists to keep read traffic off far nodes."""
+        return len(nodes) <= quorum + 1
+
     async def _get(self, pk: bytes, sk: bytes):
         h = self.schema.partition_hash(pk)
         nodes = self.replication.read_nodes(h)
         quorum = self.replication.read_quorum()
         resps = await self.helper.try_call_many(
-            self.endpoint, nodes, ["RE", pk, sk], quorum=quorum, all_at_once=False
+            self.endpoint,
+            nodes,
+            ["RE", pk, sk],
+            quorum=quorum,
+            all_at_once=self._race_reads(nodes, quorum),
         )
         values = [r.body for r in resps]
         ent = None
@@ -127,6 +192,41 @@ class Table:
                 ent = dec if ent is None else self.schema.merge_entries(ent, dec)
         if ent is not None and (n_some < len(values) or _differ(values)):
             # read-repair: push the merged value back to stale replicas
+            spawn(self._repair([ent], nodes))
+        return ent
+
+    async def get_merged_all(self, pk: bytes, sk: bytes):
+        """Inconsistency-escalation read: merge THIS key from EVERY
+        reachable replica — no quorum short-circuit — and read-repair
+        the merge back.  Used when a quorum read surfaced a state that
+        contradicts another table (e.g. an object row resolving a
+        tombstoned version, tests/test_put_abort_race.py): the row that
+        explains it may exist only on the replica the staggered quorum
+        read never consulted.  Requires at least read_quorum replies (a
+        weaker answer could go BACKWARD vs. the quorum read that
+        triggered the escalation)."""
+        registry.incr("table_get_request_counter", self._mlbl)
+        h = self.schema.partition_hash(pk)
+        nodes = self.replication.read_nodes(h)
+        results = await self.helper.call_many(
+            self.endpoint, nodes, ["RE", pk, sk]
+        )
+        values = [r.body for _n, r in results if not isinstance(r, Exception)]
+        if len(values) < self.replication.read_quorum():
+            errs = [
+                f"{n.hex()[:8]}: {r!r}"
+                for n, r in results
+                if isinstance(r, Exception)
+            ]
+            raise Quorum(self.replication.read_quorum(), len(values), errs)
+        ent = None
+        n_some = 0
+        for v in values:
+            if v is not None:
+                n_some += 1
+                dec = self.data.decode(v)
+                ent = dec if ent is None else self.schema.merge_entries(ent, dec)
+        if ent is not None and (n_some < len(values) or _differ(values)):
             spawn(self._repair([ent], nodes))
         return ent
 
@@ -148,7 +248,7 @@ class Table:
                 nodes,
                 ["RR", pk, start_sk, filt, limit, reverse],
                 quorum=quorum,
-                all_at_once=False,
+                all_at_once=self._race_reads(nodes, quorum),
             )
         merged: dict[bytes, Any] = {}
         seen_values: dict[bytes, set[bytes]] = {}

@@ -238,6 +238,35 @@ class BlockConfig:
 
 
 @dataclass
+class MetaConfig:
+    """Rebuild-specific knobs for the metadata plane (ISSUE 15): the
+    `model/` sharded tables carry their own replication factor — the
+    metadata ring, first `replication_factor` distinct nodes of each
+    partition's layout node list (table/replication.py
+    TableMetaReplication) — so table quorums stay O(1) in EC stripe
+    width, plus the table insert coalescer (table/coalesce.py) the
+    smaller quorum makes worth having.  `worker set
+    meta-coalesce-linger-msec` / `meta-coalesce-max-entries` tune the
+    live coalescers."""
+
+    # metadata replication factor.  On layouts whose own rf is SMALLER
+    # (replica modes "1"/"2") the ring falls back to the full partition
+    # node list — the effective factor is min(this, layout rf).
+    replication_factor: int = 3
+    # cross-caller coalescing of table inserts: same-destination rows
+    # from concurrent requests share one RPC per node (CodecBatcher lane
+    # pattern).  A lone insert flushes after the linger; a full batch
+    # flushes immediately.
+    coalesce_enabled: bool = True
+    coalesce_linger_msec: float = 1.0
+    coalesce_max_entries: int = 256
+    # metadata fast path: per-node LRU of COMPLETE versions' rows —
+    # safe because a visible complete version's block list is immutable
+    # (model/s3/version_table.py VersionRowCache); 0 disables
+    version_cache_entries: int = 1024
+
+
+@dataclass
 class TpuConfig:
     """Rebuild-specific: the TPU compute plane used by the EC block codec and
     batched scrub hashing (no analog in the reference)."""
@@ -300,6 +329,7 @@ class Config:
 
     allow_world_readable_secrets: bool = False
 
+    meta: MetaConfig = field(default_factory=MetaConfig)
     s3_api: S3ApiConfig = field(default_factory=S3ApiConfig)
     k2v_api: K2VApiConfig = field(default_factory=K2VApiConfig)
     s3_web: WebConfig = field(default_factory=WebConfig)
@@ -510,6 +540,8 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
                     )
                     for d in v
                 ]
+        elif k == "meta":
+            cfg.meta = MetaConfig(**_known(v, MetaConfig))
         elif k == "s3_api":
             cfg.s3_api = S3ApiConfig(**_known(v, S3ApiConfig))
         elif k == "k2v_api":
@@ -687,6 +719,34 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
                 f"{cfg.replication_factor}"
             )
         cfg.replication_factor = k + m
+    # metadata plane (ISSUE 15): validated AFTER the mode resolution
+    # above so cfg.replication_factor is final.  The layout needs at
+    # least `replication_factor` storage nodes, so that is the smallest
+    # cluster this config can run — an EXPLICIT meta factor above it
+    # could never place its ring and is a config error, not a silent
+    # runtime clamp.  The unconfigured default (3) clamps instead
+    # (replica modes "1"/"2" fall back to the full partition node list,
+    # table/replication.py).
+    mt = cfg.meta
+    if int(mt.replication_factor) < 1:
+        raise ValueError("meta.replication_factor must be >= 1")
+    if (
+        "meta" in raw
+        and "replication_factor" in raw["meta"]
+        and int(mt.replication_factor) > cfg.replication_factor
+    ):
+        raise ValueError(
+            f"meta.replication_factor {mt.replication_factor} exceeds the "
+            f"cluster replication factor {cfg.replication_factor} (the "
+            "minimum cluster size): the metadata ring could never place "
+            f"{mt.replication_factor} distinct replicas"
+        )
+    if float(mt.coalesce_linger_msec) < 0:
+        raise ValueError("meta.coalesce_linger_msec must be >= 0")
+    if int(mt.coalesce_max_entries) < 1:
+        raise ValueError("meta.coalesce_max_entries must be >= 1")
+    if int(mt.version_cache_entries) < 0:
+        raise ValueError("meta.version_cache_entries must be >= 0")
     return cfg
 
 

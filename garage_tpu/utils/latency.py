@@ -71,6 +71,7 @@ PHASES = (
     "fanout",       # piece/replica sends to the write set
     "quorum_wait",  # waiting for quorum beyond the send window
     "meta_commit",  # object/version/block-ref table commits
+    "meta_coalesce_wait",  # queue time in the table insert coalescer
     "index_read",   # object/version/bucket metadata reads
     "piece_fetch",  # gathering block bytes / EC pieces
     "decode",       # EC decode + post-decode verification

@@ -45,10 +45,22 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         ("vs_baseline", ">=", 0.35, "PUT p99 ratio vs the 1.2x target"),
     ],
     "BENCH_s3_concurrency.json": [
-        # ROADMAP item 1 / ISSUE 9 acceptance: EC PUT p99 <= 1.5x the
-        # 3-replica baseline at the 64-client level (banked 1.06)
-        ("value", "<=", 1.5,
+        # ROADMAP item 1 / ISSUE 9 acceptance was <= 1.5 (banked 1.06);
+        # the ISSUE 15 meta ring + insert coalescer re-banked at 0.478
+        # — EC PUT p99 now BEATS the 3-replica baseline at 64 clients
+        # (metadata quorums 3 nodes instead of 11, ~25 entries per
+        # coalesced table dispatch).  Ratchet to 1.0: trips if EC PUT
+        # falls behind replica again, with 2x headroom over the banked
+        # value for box noise.
+        ("value", "<=", 1.0,
          "EC/replica put-p99 ratio at 64 concurrent clients"),
+        # the meta ring shape is banked in this artifact too
+        ("detail.meta.table_nodes", "<=", 3,
+         "metadata quorums fan to the meta ring, not the stripe"),
+        # the coalescer genuinely coalesces under 64-client load
+        # (banked avg_batch 24.9; 4 still proves cross-caller merging)
+        ("detail.meta.coalesce.avg_batch", ">=", 4,
+         "table inserts coalesce across concurrent callers"),
         # batching must not tax the unloaded case: single-client EC PUT
         # p99 stays under the pre-batcher sequential pipeline's ~0.9 s
         # measured on the banking box (banked 0.66 s; c=1 runs carry
@@ -62,16 +74,24 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
          "64-client EC PUT pipeline overlap (1.0 = sequential)"),
     ],
     "BENCH_s3_readpath.json": [
-        # ISSUE 13: the read-path attack landed — systematic streaming +
-        # hedged fetches + hot-block cache took the EC/replica GET p99
-        # ratio from the banked 13.28x (ISSUE 12) to 3.0-4.4x across
-        # runs on this box.  Ceiling at 6.5 (half the old gap, the
-        # ISSUE 13 acceptance bound): trips if the cache or the
-        # systematic fast path silently stops serving reads, while
-        # leaving room for box noise.  index_read now carries ~80% of
-        # the EC GET waterfall — that residual is ROADMAP item 3.
-        ("value", "<=", 6.5,
-         "EC/replica GET p99 ratio (read-path pipeline, ISSUE 13)"),
+        # ISSUE 13 rebuilt the block half of the GET pipeline
+        # (13.28x -> 3.0-4.4x, ceiling 6.5); ISSUE 15 decoupled the
+        # metadata RF from the stripe (index_read quorums over 3 nodes
+        # instead of 11) — ceiling ratcheted to the ISSUE 15 acceptance
+        # bound 3.0.  Trips if the meta ring, the systematic fast path
+        # or the hot-block cache silently stops serving reads.
+        ("value", "<=", 3.0,
+         "EC/replica GET p99 ratio (read pipeline + meta ring)"),
+        # the index_read share of the EC GET waterfall: ~0.80 before
+        # the meta ring, must stay under 0.45 (ISSUE 15 satellite)
+        ("detail.meta.index_read_share", "<=", 0.45,
+         "index_read share of the EC GET critical path (meta ring)"),
+        # quorum shape banked: the meta ring fans table reads to 3
+        # nodes while the stripe stays 11 (presence + ceiling in one)
+        ("detail.meta.table_nodes", "<=", 3,
+         "metadata quorums fan to the meta ring, not the stripe"),
+        ("detail.meta.block_nodes", ">=", 11,
+         "block placement still spans the full ec:8:3 stripe"),
         # the cache must actually serve the zipfian mix, and a healthy
         # cluster must (near-)never reconstruct: banked 213 hits /
         # 0 reconstruct decodes over 216 GETs; <=2 tolerates a stray

@@ -1,0 +1,162 @@
+"""ISSUE 15 acceptance: on an 11-node ec:8:3 cluster the metadata
+plane quorums over 3 nodes while block fan-out keeps the full stripe,
+and read-after-write holds across a layout change.
+
+The RPC spy wraps the S3-serving node's RpcHelper quorum entry points
+(`try_write_many_sets` for table writes, `try_call_many` for table
+reads) plus raw `call` (block piece sends), so the assertion is on what
+actually went over the wire, per endpoint."""
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_ec_cluster import make_ec_cluster, stop_cluster  # noqa: E402
+
+from garage_tpu.api.s3.api_server import S3ApiServer  # noqa: E402
+from garage_tpu.api.s3.client import S3Client  # noqa: E402
+from garage_tpu.rpc.layout.types import NodeRole  # noqa: E402
+
+META_TABLES = ("table/object", "table/version", "table/block_ref")
+
+
+class RpcSpy:
+    """Records (endpoint path, distinct target nodes, quorum) per
+    quorum call, and raw per-node sends for fan-out accounting."""
+
+    def __init__(self, helper):
+        self.helper = helper
+        self.writes = []  # (path, n_distinct_nodes, quorum)
+        self.reads = []  # (path, n_candidate_nodes, quorum)
+        self.sends = {}  # path -> set of node ids actually sent to
+        self._orig = (
+            helper.try_write_many_sets,
+            helper.try_call_many,
+            helper.call,
+        )
+
+        async def spy_write(endpoint, write_sets, msg, quorum, **kw):
+            nodes = {n for s in write_sets for n in s}
+            self.writes.append((endpoint.path, len(nodes), quorum))
+            return await self._orig[0](
+                endpoint, write_sets, msg, quorum, **kw
+            )
+
+        async def spy_read(endpoint, nodes, msg, quorum, **kw):
+            self.reads.append((endpoint.path, len(nodes), quorum))
+            return await self._orig[1](endpoint, nodes, msg, quorum, **kw)
+
+        async def spy_call(endpoint, node, msg, *a, **kw):
+            self.sends.setdefault(endpoint.path, set()).add(bytes(node))
+            return await self._orig[2](endpoint, node, msg, *a, **kw)
+
+        helper.try_write_many_sets = spy_write
+        helper.try_call_many = spy_read
+        helper.call = spy_call
+
+    def restore(self):
+        (
+            self.helper.try_write_many_sets,
+            self.helper.try_call_many,
+            self.helper.call,
+        ) = self._orig
+
+
+@pytest.mark.slow
+def test_ec83_meta_quorums_over_3_nodes_block_fanout_11(tmp_path):
+    async def main():
+        garages = await make_ec_cluster(
+            tmp_path, n=11, mode="ec:8:3", block_size=16384
+        )
+        s3 = S3ApiServer(garages[0])
+        await s3.start("127.0.0.1", 0)
+        ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
+        key = await garages[0].helper.create_key("meta-acc")
+        key.params().allow_create_bucket.update(True)
+        await garages[0].key_table.insert(key)
+        client = S3Client(ep, key.key_id, key.secret())
+        try:
+            await client.create_bucket("meta")
+            body = os.urandom(60_000)  # ~4 blocks: real EC write path
+            # warmup (connection setup, key-table reads)
+            await client.put_object("meta", "warm", body)
+            await client.get_object("meta", "warm")
+
+            spy = RpcSpy(garages[0].helper_rpc)
+            try:
+                await client.put_object("meta", "obj1", body)
+                got = await client.get_object("meta", "obj1")
+                assert got == body
+            finally:
+                spy.restore()
+
+            # --- metadata quorums: 3 nodes, read 2 / write 2 ----------
+            meta_writes = [
+                w for w in spy.writes if w[0] in META_TABLES
+            ]
+            assert meta_writes, "no table quorum writes recorded"
+            for path, n_nodes, quorum in meta_writes:
+                assert n_nodes == 3, (path, n_nodes)
+                assert quorum == 2, (path, quorum)
+            meta_reads = [r for r in spy.reads if r[0] in META_TABLES]
+            assert meta_reads, "no table quorum reads recorded"
+            for path, n_nodes, quorum in meta_reads:
+                assert n_nodes == 3, (path, n_nodes)
+                assert quorum == 2, (path, quorum)
+
+            # --- block plane: the stripe fans to all 11 nodes ---------
+            block_nodes = spy.sends.get("block/data", set())
+            assert len(block_nodes) == 11, len(block_nodes)
+
+            # --- read-after-write across a layout change --------------
+            lm = garages[0].layout_manager
+            lm.stage_role(
+                garages[3].node_id,
+                NodeRole(zone="dc3", capacity=5 * 10**11),
+            )
+            lm.apply_staged()
+
+            stop_flag = {"stop": False}
+            failures: list[str] = []
+
+            async def writer_reader(i: int):
+                k = f"rw-{i}"
+                ver = 0
+                last_acked = 0
+                while not stop_flag["stop"]:
+                    ver += 1
+                    payload = f"{ver}:".encode() + os.urandom(2000)
+                    try:
+                        await client.put_object("meta", k, payload)
+                        last_acked = ver
+                    except Exception:  # noqa: BLE001 — indeterminate
+                        pass
+                    try:
+                        got = await client.get_object("meta", k)
+                        seen = int(got.split(b":")[0])
+                        if seen < last_acked:
+                            failures.append(
+                                f"{k}: read v{seen} after acked v{last_acked}"
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(f"{k}: read failed: {e!r}")
+                    await asyncio.sleep(0.02)
+
+            tasks = [
+                asyncio.create_task(writer_reader(i)) for i in range(3)
+            ]
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                await asyncio.sleep(0.2)
+            stop_flag["stop"] = True
+            await asyncio.gather(*tasks)
+            assert not failures, failures[:5]
+        finally:
+            await stop_cluster(garages, [s3], [client])
+
+    asyncio.run(main())

@@ -1,0 +1,194 @@
+"""Pinned repro for the jepsen `404 NoSuchKey: version data missing`
+lead (ISSUE 15 satellite; first seen as the PR 13 combined-nemeses
+flake under CPU load).
+
+Mechanism (table-plane, deterministic — no CPU load needed):
+
+  1. an acked overwrite C of key k reaches only a MINORITY of object
+     replicas before the writer's final quorum wait times out (the
+     write itself is indeterminate);
+  2. the node that DID receive C's "complete" row CRDT-prunes the
+     previous version B and its `updated()` cascade quorum-tombstones
+     B's version-table row (correct if C is durable);
+  3. the writer's abort cleanup then inserts C as "aborted" — which
+     beats "complete" in the CRDT state order — so the object row
+     resolves B again everywhere... whose version row is now deleted.
+     Every GET of k 404s with "version data missing", and nothing
+     heals it until the next successful overwrite.
+
+The fix (api/s3/objects.py handle_put_object): after stream_blocks the
+version/block data is fully quorum-committed, so a failure of the FINAL
+"complete" object-row insert is in the indeterminate zone — the cleanup
+leaves the uploading row (pruned by the next successful overwrite) and
+returns 500 instead of un-completing a row that may have landed.
+
+Documented in doc/metadata-replication.md ("Known race: aborted
+overwrite vs. version cascade").
+"""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from test_jepsen import boot_cluster  # noqa: E402
+
+from garage_tpu.api.s3.client import S3Error  # noqa: E402
+from garage_tpu.model.s3.object_table import (  # noqa: E402
+    Object,
+    ObjectVersion,
+    next_timestamp,
+)
+from garage_tpu.utils.data import gen_uuid  # noqa: E402
+
+BODY_A = b"1:" + b"a" * 4000  # > INLINE_THRESHOLD: real block-store path
+BODY_B = b"2:" + b"b" * 4000
+
+
+async def _teardown(garages, servers, clients):
+    for c in clients:
+        await c.close()
+    for s in servers:
+        await s.stop()
+    for g in garages:
+        await g.stop()
+
+
+async def _wait_version_deleted(garages, vid, timeout=20.0):
+    """True once the version row of `vid` is tombstoned on a quorum
+    (the insert-queue worker drains the cascade within ~1 s)."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        ver = await garages[0].version_table.get(bytes(vid), b"")
+        if ver is not None and ver.deleted.get():
+            return True
+        await asyncio.sleep(0.25)
+    return False
+
+
+def test_partial_complete_then_abort_tombstones_last_acked_version(tmp_path):
+    """The MECHANISM, pinned: a minority-landed complete overwrite that
+    is later aborted leaves the last ACKED version's object row resolving
+    a tombstoned version row — the exact `404 NoSuchKey: version data
+    missing` state the jepsen nemeses produced under CPU starvation.
+    This is inherent to the CRDT state order (aborted must stay terminal
+    and prune must cascade); the PUT path avoids the interleaving by
+    never aborting past the indeterminate zone (see the companion test
+    below)."""
+
+    async def main():
+        garages, servers, clients, _key = await boot_cluster(tmp_path)
+        try:
+            await clients[0].create_bucket("jepsen")
+            await clients[0].put_object("jepsen", "k", BODY_A)
+            assert await clients[0].get_object("jepsen", "k") == BODY_A
+
+            g0 = garages[0]
+            bucket_id = await g0.helper.resolve_bucket("jepsen")
+            obj = await g0.object_table.get(bucket_id, b"k")
+            vis = obj.last_visible()
+            vid_a = bytes(vis.data["vid"])
+
+            # step 1+2: C's "complete" row lands on ONE node only (the
+            # table-plane injection: a quorum write that died after its
+            # first ack).  That node's prune cascade tombstones B.
+            c_uuid = gen_uuid()
+            c_complete = ObjectVersion(
+                c_uuid,
+                next_timestamp(obj),
+                "complete",
+                {
+                    "t": "first_block",
+                    "vid": c_uuid,
+                    "meta": {"size": 1, "etag": "e", "headers": []},
+                },
+            )
+            g1t = garages[1].object_table
+            g1t.data.update_entry(
+                g1t.data.encode(Object(bucket_id, "k", [c_complete]))
+            )
+            assert await _wait_version_deleted(garages, vid_a), (
+                "cascade never tombstoned the pruned version"
+            )
+
+            # step 3: the old cleanup aborts C cluster-wide
+            c_aborted = ObjectVersion(
+                c_uuid,
+                c_complete.timestamp,
+                "aborted",
+                {"t": "first_block", "vid": c_uuid},
+            )
+            await g0.object_table.insert(
+                Object(bucket_id, "k", [c_aborted])
+            )
+
+            # the 404 state: object row resolves B, version row of B is
+            # tombstoned, C is aborted — nothing left to serve
+            with pytest.raises(S3Error, match="version data missing"):
+                await asyncio.wait_for(
+                    clients[2].get_object("jepsen", "k"), 10
+                )
+        finally:
+            await _teardown(garages, servers, clients)
+
+    asyncio.run(main())
+
+
+def test_put_overwrite_indeterminate_complete_not_aborted(tmp_path):
+    """The FIX: when the final complete insert fails indeterminately
+    (landed on a minority, then the quorum wait died), the PUT returns
+    500 WITHOUT aborting — the landed row spreads by read-repair/merge
+    and the key keeps serving (new body once converged, old body at
+    worst).  Never `404 version data missing`."""
+
+    async def main():
+        garages, servers, clients, _key = await boot_cluster(tmp_path)
+        try:
+            await clients[0].create_bucket("jepsen")
+            await clients[0].put_object("jepsen", "k", BODY_A)
+
+            g0 = garages[0]
+            orig_insert = g0.object_table.insert
+
+            async def flaky_insert(entry):
+                v = entry.versions[0]
+                if (
+                    v.state == "complete"
+                    and v.data.get("t") == "first_block"
+                ):
+                    # the injected indeterminate quorum write: land the
+                    # row on ONE node, then fail like a timeout
+                    g1t = garages[1].object_table
+                    g1t.data.update_entry(g0.object_table.data.encode(entry))
+                    raise asyncio.TimeoutError(
+                        "injected: final insert quorum died after 1 ack"
+                    )
+                return await orig_insert(entry)
+
+            g0.object_table.insert = flaky_insert
+            try:
+                with pytest.raises(Exception):
+                    await clients[0].put_object("jepsen", "k", BODY_B)
+            finally:
+                g0.object_table.insert = orig_insert
+
+            # the key must KEEP SERVING: the partial complete row spreads
+            # via merge/read-repair and B2 becomes visible; at no point
+            # may the read 404
+            deadline = asyncio.get_event_loop().time() + 30
+            got = None
+            while asyncio.get_event_loop().time() < deadline:
+                got = await clients[2].get_object("jepsen", "k")
+                assert got in (BODY_A, BODY_B)
+                if got == BODY_B:
+                    break
+                await asyncio.sleep(0.3)
+            assert got == BODY_B, "landed complete row never converged"
+        finally:
+            await _teardown(garages, servers, clients)
+
+    asyncio.run(main())
