@@ -270,6 +270,56 @@ def prelower_kernels(args, dev) -> None:
         print(f"# prelower skipped: {type(e).__name__}: {e}", file=sys.stderr)
 
 
+def codec_xray_detail(k, m, shard_bytes) -> dict:
+    """The `detail.codec` block bench_diff floors check (ISSUE 17):
+    drive a short instrumented section through the PRODUCTION codec
+    dispatch path — ops/ec_tpu.EcTpu fused encode+hash (odd batch sizes
+    so pow2 bucketing actually pads) plus a mini codec-batcher session
+    (lane linger + flush attribution) — then reduce the process-wide
+    ops/telemetry.codec_snapshot to the banked scalars.  The timed loop
+    above calls jitted functions directly (measurement must not pay
+    observatory overhead), so this section is what makes the X-ray
+    numbers appear in the artifact at all."""
+    import asyncio
+
+    import numpy as np
+
+    from garage_tpu.ops import telemetry
+    from garage_tpu.ops.ec_tpu import EcTpu
+    from garage_tpu.utils.metrics import registry
+
+    shard = min(shard_bytes, 4096)
+    ec = EcTpu(k, m)
+    rng = np.random.default_rng(1)
+    for b in (3, 5):  # pow2 buckets pad 3->4 and 5->8: waste 0.25, 0.375
+        ec.encode_and_hash(
+            rng.integers(0, 256, (b, k, shard), dtype=np.uint8)
+        )
+
+    async def lane_session():
+        from garage_tpu.block.codec.ec import EcCodec
+        from garage_tpu.block.codec_batch import CodecBatcher
+
+        batcher = CodecBatcher(EcCodec(k, m), linger_msec=2.0)
+        try:
+            payload = bytes(rng.integers(0, 256, k * 256, dtype=np.uint8))
+            await asyncio.gather(
+                *(batcher.encode(payload) for _ in range(8))
+            )
+        finally:
+            await batcher.close()
+
+    asyncio.run(lane_session())
+    snap = telemetry.codec_snapshot(registry)
+    return {
+        "pad_waste": snap["padWaste"],
+        "compile_events": snap["compileEvents"],
+        "compile_secs": snap["compileSecs"],
+        "overlap_efficiency": snap["overlapEfficiency"],
+        "lane_linger_p99": snap["laneLingerP99"],
+    }
+
+
 def child_main(args) -> None:
     """Measurement body — runs in a subprocess the parent can hard-kill."""
     from garage_tpu.utils.compile_cache import enable_persistent_cache
@@ -378,6 +428,15 @@ def child_main(args) -> None:
     metric = "ec%d%d_%s_GBps" % (k, m, "repair" if args.repair else "encode")
     if args.hash:
         metric = "ec%d%d_encode_hash_GBps" % (k, m)
+    # codec X-ray detail (ISSUE 17) — advisory: a broken observatory
+    # must not cost the banked throughput number
+    try:
+        codec_detail = codec_xray_detail(k, m, shard_bytes)
+    except Exception as e:  # noqa: BLE001 — advisory only
+        print(f"# codec x-ray section failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        codec_detail = None
+
     print(
         json.dumps(
             {
@@ -387,6 +446,7 @@ def child_main(args) -> None:
                 "vs_baseline": round(gbps / 10.0, 4),
                 "platform": dev.platform,
                 "batch": args.batch,
+                "detail": {"codec": codec_detail},
             }
         )
     )

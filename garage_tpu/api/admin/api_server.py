@@ -266,6 +266,17 @@ class AdminApiServer:
 
             return web.json_response(durability_response(g))
 
+        if path == "/v1/codec" and request.method == "GET":
+            # codec X-ray (ops/telemetry.py + rpc/telemetry_digest.py):
+            # local per-kernel pad accounting, compile events, overlap
+            # efficiency, batcher lane linger, plus the cluster view from
+            # the gossiped codec.* digest keys — kernel/cache/lane
+            # breakdowns live HERE (JSON), the exposition only carries
+            # bounded label sets
+            from ...rpc.telemetry_digest import codec_response
+
+            return web.json_response(codec_response(g))
+
         if path == "/v1/traffic" and request.method == "GET":
             # traffic observatory (rpc/traffic.py): local hot-object /
             # hot-bucket top-K, op mix, size histogram, zipf skew, the

@@ -215,21 +215,28 @@ class EcCodec(BlockCodec):
         nbytes = sum(self.k * self.piece_len(len(b)) for b in blocks)
         _count("encode", "numpy", len(blocks), nbytes)
         out: list[tuple[list[bytes], list[bytes] | None]] = []
-        with telemetry.dispatch("ec_encode_host", "host", len(blocks), nbytes):
-            for block in blocks:
-                data = self._split(block)  # zero-copy view when aligned
-                parity = gf.apply_matrix(self._parity_mat, data)
-                pieces = [bytes(data[i]) for i in range(self.k)] + [
-                    bytes(parity[i]) for i in range(self.m)
-                ]
-                hashes: list[bytes] | None = []
-                for p in pieces:
-                    h = _native.blake3(p)
-                    if h is None:  # native lib absent: receiver hashes
-                        hashes = None
-                        break
-                    hashes.append(h)
-                out.append((pieces, hashes))
+        with telemetry.dispatch(
+            "ec_encode_host", "host", len(blocks), nbytes
+        ) as rec:
+            # the host path never pads (no fixed-shape executable), so
+            # its pad-waste is an honest 0 — keeping the kernel in the
+            # X-ray's pad table instead of absent
+            rec.pad(len(blocks), len(blocks))
+            with rec.compute():
+                for block in blocks:
+                    data = self._split(block)  # zero-copy view when aligned
+                    parity = gf.apply_matrix(self._parity_mat, data)
+                    pieces = [bytes(data[i]) for i in range(self.k)] + [
+                        bytes(parity[i]) for i in range(self.m)
+                    ]
+                    hashes: list[bytes] | None = []
+                    for p in pieces:
+                        h = _native.blake3(p)
+                        if h is None:  # native lib absent: receiver hashes
+                            hashes = None
+                            break
+                        hashes.append(h)
+                    out.append((pieces, hashes))
         return out
 
     def note_systematic_read(self, block_len: int) -> None:
@@ -304,8 +311,12 @@ class EcCodec(BlockCodec):
         from ...ops import telemetry
 
         nbytes = sum(self.k * self.piece_len(n) for _p, n in items)
-        with telemetry.dispatch("ec_decode_host", "host", len(items), nbytes):
-            return [self.decode(p, n) for p, n in items]
+        with telemetry.dispatch(
+            "ec_decode_host", "host", len(items), nbytes
+        ) as rec:
+            rec.pad(len(items), len(items))
+            with rec.compute():
+                return [self.decode(p, n) for p, n in items]
 
     def reconstruct_batch(self, batches):
         for idx, (pieces, _w, _n) in enumerate(batches):

@@ -57,6 +57,14 @@ Metric families (doc/monitoring.md):
                                   with at least one other block
   block_codec_batch_queue_depth{id}  blocks waiting in a lane (G; one
                                   instance per lane)
+  block_codec_batch_lane_linger{lane,flush}  seconds each block sat in
+                                  its lane from submit to dispatch start
+                                  (H) — joined with the flush-reason
+                                  label, this answers "is latency going
+                                  to coalescing?" per lane instead of
+                                  per guess (Codec X-ray, ISSUE 17; the
+                                  digest's `codec.ll99` is this family's
+                                  merged p99)
 """
 
 from __future__ import annotations
@@ -212,8 +220,13 @@ class _Lane:
     async def _dispatch(self, batch: list[_Entry], flush: str) -> None:
         if not batch:
             return
+        now = time.monotonic()
+        linger_lbl = (("lane", self.name), ("flush", flush))
         for e in batch:
             e.started.set()
+            registry.observe(
+                "block_codec_batch_lane_linger", linger_lbl, now - e.arrived
+            )
         if self.size_metrics:
             registry.observe(
                 "block_codec_batch_size", (), float(len(batch))

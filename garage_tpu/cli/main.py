@@ -174,6 +174,20 @@ def main(argv=None):
         help="redundancy ledger: blocks by class, zone-loss exposure, "
         "repair ETA (block/durability.py)",
     )
+    clu_sub.add_parser(
+        "codec",
+        help="codec X-ray: dispatch pad waste, compile events, overlap "
+        "efficiency, batcher lane linger (ops/telemetry.py)",
+    )
+
+    cdx = sub.add_parser(
+        "codec", help="codec X-ray: local accelerator dispatch economics"
+    )
+    cdx_sub = cdx.add_subparsers(dest="codec_cmd", required=True)
+    cdx_sub.add_parser(
+        "top", help="per-kernel breakdown: pad waste, overlap, compile cost, "
+        "batcher lane linger",
+    )
 
     ovl = sub.add_parser(
         "overload", help="overload-control plane: admission + shedding ladder"
@@ -482,6 +496,27 @@ def _render_cluster_top(r: dict) -> str:
             f"meta quorums\trf {self_meta.get('rf')} "
             f"(read {self_meta.get('rq')} / write {self_meta.get('wq')})"
         )
+    # codec X-ray (ISSUE 17): cluster dispatch economics at a glance —
+    # worst-node pad waste and the cluster compile burden
+    if agg.get("codecDispatches"):
+        cpw = agg.get("codecPadWasteWorst")
+        head.append(
+            f"codec\t{agg.get('codecDispatches', 0):g} dispatches, "
+            f"pad waste {'-' if cpw is None else f'{cpw * 100:.1f}%'} worst, "
+            f"{agg.get('codecCompileEvents', 0):g} compiles "
+            f"({agg.get('codecCompileSeconds', 0):g}s)"
+        )
+    # TPU probe verdict (bench.py phased_probe, ISSUE 11): the answering
+    # box's newest banked wedge profile — structured evidence, not
+    # "wedged at devices" folklore
+    probe = r.get("tpuProbe")
+    if probe:
+        head.append(
+            f"tpu probe\t{probe.get('result')} at "
+            f"{probe.get('wedgedAt') or '-'} (rc {probe.get('rc')}"
+            + (", timeout" if probe.get("timedOut") else "")
+            + f", banked {probe.get('utc')})"
+        )
     out = format_table(head) + "\n\n"
     rows = [
         "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry\thot\tflags"
@@ -676,6 +711,96 @@ def _render_cluster_durability(r: dict) -> str:
     return out
 
 
+def _render_cluster_codec(r: dict) -> str:
+    """`cluster codec`: the codec X-ray as an operator table — cluster
+    aggregate, then one row per node from the gossiped codec.* digest
+    keys (model: `cluster durability`)."""
+    agg = (r.get("cluster") or {}).get("aggregate") or {}
+    local = r.get("local") or {}
+    pw = agg.get("padWasteWorst")
+    ovl = agg.get("overlapEfficiencyWorst")
+    ll = agg.get("laneLingerP99SecondsWorst")
+    head = [
+        f"dispatches\t{agg.get('dispatches', 0):g} cluster-wide",
+        f"pad waste\t{'-' if pw is None else f'{pw * 100:.1f}%'} (worst node)",
+        f"compiles\t{agg.get('compileEvents', 0):g} events, "
+        f"{agg.get('compileSeconds', 0):g}s total",
+        f"overlap\t{'-' if ovl is None else f'{ovl:.2f}'} "
+        "(wall / transfer+compute; 1.0 = fully sequential)",
+        f"lane linger p99\t{'-' if ll is None else _ms(ll)} (worst node)",
+        f"platforms\t{', '.join(local.get('platforms') or []) or '-'}",
+    ]
+    out = format_table(head) + "\n"
+    nodes = (r.get("cluster") or {}).get("nodes") or []
+    rows = ["id\tup\tdisp\tpad-waste\tcompiles\tcompile-s\tovl\tlinger99"]
+    for n in nodes:
+        c = n.get("codec")
+        if not isinstance(c, dict):
+            rows.append(
+                f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+                "-\t-\t-\t-\t-\tno-digest"
+            )
+            continue
+        rows.append(
+            f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+            f"{c.get('dsp', 0):g}\t{(c.get('pw') or 0) * 100:.1f}%\t"
+            f"{c.get('ce', 0):g}\t{c.get('cs', 0):g}\t"
+            f"{c.get('ovl', 0):.2f}\t{_ms(c.get('ll99'))}"
+        )
+    out += "\n== nodes ==\n" + format_table(rows)
+    return out
+
+
+def _render_codec_top(r: dict) -> str:
+    """`codec top`: this node's per-kernel dispatch economics — where
+    the accelerator's batches pad, compile and linger (the `local` leg
+    of the shared codec_response serialization)."""
+    local = r.get("local") or {}
+    head = [
+        f"dispatches\t{local.get('dispatches', 0):g} (this node)",
+        f"pad waste\t{(local.get('padWaste') or 0) * 100:.1f}% "
+        "of dispatched rows",
+        f"compiles\t{local.get('compileEvents', 0):g} events, "
+        f"{local.get('compileSecs', 0):g}s",
+        f"platforms\t{', '.join(local.get('platforms') or []) or '-'}",
+    ]
+    out = format_table(head) + "\n"
+    kernels = local.get("kernels") or {}
+    if kernels:
+        rows = ["kernel\trows\tpadded-to\tpad-waste\toverlap"]
+        for name, k in sorted(
+            kernels.items(), key=lambda kv: -kv[1].get("padded", 0)
+        ):
+            kovl = k.get("overlapEfficiency")
+            rows.append(
+                f"{name}\t{k.get('requested', 0):g}\t{k.get('padded', 0):g}\t"
+                f"{(k.get('padWaste') or 0) * 100:.1f}%\t"
+                f"{'-' if kovl is None else f'{kovl:.2f}'}"
+            )
+        out += "\n== kernels ==\n" + format_table(rows) + "\n"
+    comp = local.get("compile") or {}
+    if comp:
+        rows = ["cache\tcompile events\tsecs"]
+        for name, c in sorted(
+            comp.items(), key=lambda kv: -kv[1].get("secs", 0)
+        ):
+            rows.append(f"{name}\t{c.get('events', 0)}\t{c.get('secs', 0):g}")
+        out += "\n== compile ==\n" + format_table(rows) + "\n"
+    lanes = local.get("lanes") or {}
+    if lanes:
+        rows = ["lane\tflush\tblocks\tlinger-total\tlinger-p99"]
+        for lname, lane in sorted(lanes.items()):
+            for fname, fl in sorted((lane.get("flush") or {}).items()):
+                p99 = fl.get("lingerP99")
+                rows.append(
+                    f"{lname}\t{fname}\t{fl.get('blocks', 0)}\t"
+                    f"{fl.get('lingerSecsTotal', 0):g}s\t"
+                    f"{'-' if p99 is None else _ms(p99)}"
+                )
+        out += "\n== batcher lanes ==\n" + format_table(rows)
+    return out
+
+
 async def dispatch(args, call, config) -> str | None:
     from ..utils.config import _parse_capacity
 
@@ -744,6 +869,9 @@ async def dispatch(args, call, config) -> str | None:
                 f"breakers open\t{rpc.get('open', 0)}",
                 f"repair backlog\t{(tm.get('repair') or {}).get('backlog', 0)}",
                 f"tpu dispatch/s\t{(tm.get('tpu') or {}).get('dps', 0):.2f}",
+                "codec pad waste / compiles\t"
+                f"{(tm.get('codec') or {}).get('pw', 0):.1%} / "
+                f"{(tm.get('codec') or {}).get('ce', 0):g}",
             ]
             slo = tm.get("slo")
             if slo:
@@ -753,6 +881,21 @@ async def dispatch(args, call, config) -> str | None:
                     f"{slo['lat']['rem'] * 100:.1f}%"
                 )
             out += format_table(drow)
+        probe = st.get("tpuProbe")
+        if probe:
+            # newest banked TPU probe wedge (bench.py phased_probe): the
+            # structured failure_reason, not "wedged at devices" folklore
+            out += "\n\n==== TPU PROBE (last banked failure) ====\n"
+            out += format_table(
+                [
+                    f"result\t{probe.get('result')}",
+                    f"wedged at\t{probe.get('wedgedAt') or '-'}",
+                    f"phase rc\t{probe.get('rc')}"
+                    + (" (timeout)" if probe.get("timedOut") else ""),
+                    f"phase secs\t{probe.get('dt')}",
+                    f"banked\t{probe.get('utc')} ({probe.get('profile')})",
+                ]
+            )
         return out
 
     if args.cmd == "cluster":
@@ -770,6 +913,11 @@ async def dispatch(args, call, config) -> str | None:
             if args.json:
                 return json.dumps(r, indent=2, default=repr)
             return _render_cluster_durability(r)
+        if args.cluster_cmd == "codec":
+            r = await call("codec")
+            if args.json:
+                return json.dumps(r, indent=2, default=repr)
+            return _render_cluster_codec(r)
         if args.cluster_cmd == "telemetry":
             return json.dumps(
                 await call("cluster-telemetry"), indent=2, default=repr
@@ -973,6 +1121,12 @@ async def dispatch(args, call, config) -> str | None:
                      "allow_create_bucket": acb},
                 )
             )
+
+    if args.cmd == "codec" and args.codec_cmd == "top":
+        r = await call("codec")
+        if jd:
+            return jd(r)
+        return _render_codec_top(r)
 
     if args.cmd == "overload" and args.overload_cmd == "status":
         r = await call("overload-status")

@@ -490,6 +490,8 @@ class Garage:
         # flight recorder plane (utils/flight.py), wired in start()
         self.flight_recorder = None
         self.watchdog = None
+        # stall auto-capture (utils/profiler.py), opt-in via [admin] stall_profile
+        self.stall_profiler = None
         # latency X-ray + canary prober (utils/latency.py, api/s3/canary.py)
         self._latency_enabled = False
         # traffic observatory (rpc/traffic.py), enabled in start()
@@ -559,6 +561,14 @@ class Garage:
             self.watchdog = flight.EventLoopWatchdog(
                 threshold=adm.event_loop_watchdog_threshold_msec / 1000.0
             )
+            if adm.stall_profile:
+                # stall auto-capture: every counted stall episode samples
+                # the wedged process from the watchdog thread and records
+                # a `loop-stall-profile` flight event (utils/profiler.py)
+                from ..utils.profiler import StallProfiler
+
+                self.stall_profiler = StallProfiler()
+                self.watchdog.on_stall = self.stall_profiler.on_stall
             self.watchdog.start()
         if adm.latency_xray:
             # latency X-ray (utils/latency.py): phase attribution via a
@@ -814,6 +824,7 @@ class Garage:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        self.stall_profiler = None
         if self.flight_recorder is not None:
             from ..utils import flight
 

@@ -363,10 +363,13 @@ class EcTpu:
         with telemetry.dispatch(
             kernel, telemetry.resolved_platform(self.platform),
             x.shape[0], x.nbytes,
-        ):
-            return self._apply_inner(bitmat, x, kernel)
+        ) as rec:
+            return self._apply_inner(bitmat, x, kernel, rec)
 
-    def _apply_inner(self, bitmat, x: np.ndarray, kernel: str = "ec") -> np.ndarray:
+    def _apply_inner(
+        self, bitmat, x: np.ndarray, kernel: str = "ec",
+        rec: telemetry.DispatchRecord | None = None,
+    ) -> np.ndarray:
         n = self._mesh_width()
         # auto-detected meshes only engage once every device gets >=2
         # blocks; an explicitly pinned width engages as soon as padding
@@ -374,7 +377,7 @@ class EcTpu:
         min_batch = 2 * n if self._n_dev is None else n
         if n > 1 and x.shape[0] >= min_batch:
             try:
-                out = self._apply_mesh(bitmat, x, n)
+                out = self._apply_mesh(bitmat, x, n, rec)
                 telemetry.mesh_engaged(
                     kernel, telemetry.resolved_platform(self.platform), n
                 )
@@ -392,12 +395,21 @@ class EcTpu:
         b = x.shape[0]
         bucket = bucket_batch(b)
         record_cache_event("ec_dispatch_bucket", bucket == b)
+        if rec is None:
+            # detached record: still counts pads/phases, but no wall is
+            # attributed at exit (only `_apply` owns the dispatch timer)
+            rec = telemetry.DispatchRecord(kernel, "")
+        rec.pad(b, bucket)
         for impl in dict.fromkeys((self._impl, "einsum")):
             fn = ec_apply_fn(self.platform, impl)
-            xp = pad_to_bucket(x, bucket)
+            with rec.transfer():
+                xp = pad_to_bucket(x, bucket)
             try:
-                # graft-lint: allow-donation(ec_apply_fn also drives long-lived bench/device arrays; donation would invalidate them)
-                out = np.asarray(fn(bitmat, xp))
+                with rec.compute():
+                    # graft-lint: allow-donation(ec_apply_fn also drives long-lived bench/device arrays; donation would invalidate them)
+                    out_dev = fn(bitmat, xp)
+                with rec.transfer():
+                    out = np.asarray(out_dev)
             except Exception:
                 if impl == "einsum":
                     raise
@@ -408,7 +420,10 @@ class EcTpu:
             return out[:b]
         raise AssertionError("unreachable: einsum attempt raises on failure")
 
-    def _apply_mesh(self, bitmat, x: np.ndarray, n: int) -> np.ndarray:
+    def _apply_mesh(
+        self, bitmat, x: np.ndarray, n: int,
+        rec: telemetry.DispatchRecord | None = None,
+    ) -> np.ndarray:
         """Shard the block batch over the n-device mesh: the batch axis
         is padded to its power-of-two bucket AND to a multiple of n with
         zero blocks (one compiled executable per bucket instead of one
@@ -418,11 +433,22 @@ class EcTpu:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         b = x.shape[0]
-        xp = pad_for_mesh(x, n)
+        if rec is None:
+            # detached record (see _apply_inner)
+            rec = telemetry.DispatchRecord("ec", "")
+        with rec.transfer():
+            xp = pad_for_mesh(x, n)
+        rec.pad(b, xp.shape[0])
         fn, mesh = ec_apply_fn_mesh(self.platform, self._impl, n)
-        xd = jax.device_put(jnp.asarray(xp), NamedSharding(mesh, P("blocks")))
-        # graft-lint: allow-donation(mesh fallback retries the same host batch single-device; a donated input would already be gone)
-        out = np.asarray(fn(bitmat, xd))
+        with rec.transfer():
+            xd = jax.device_put(
+                jnp.asarray(xp), NamedSharding(mesh, P("blocks"))
+            )
+        with rec.compute():
+            # graft-lint: allow-donation(mesh fallback retries the same host batch single-device; a donated input would already be gone)
+            out_dev = fn(bitmat, xd)
+        with rec.transfer():
+            out = np.asarray(out_dev)
         return out[:b]
 
     def encode(self, data: np.ndarray) -> np.ndarray:
@@ -453,18 +479,24 @@ class EcTpu:
         for impl in dict.fromkeys((self._impl, "einsum")):
             try:
                 fn = ec_encode_hash_fn(self.platform, impl, s)
-                # the shard input is DONATED on device backends.  Host
-                # numpy inputs survive donation (JAX donates the
-                # transient device copy, never the host buffer), so
-                # today's retry is safe either way — the rebind inside
-                # the loop is the donation rule's retry idiom, kept
-                # honest for the day a caller hands this path a
-                # device-resident batch (ROADMAP item 2's AOT/pjit
-                # migration), where attempt 1 WOULD consume the buffer
-                x = pad_to_bucket(np.asarray(data), bucket)
-                with telemetry.dispatch("ec_encode_hash", plat, b, data.nbytes):
-                    parity, hashes = fn(self._enc_bitmat, x)
-                    parity, hashes = np.asarray(parity), np.asarray(hashes)
+                with telemetry.dispatch(
+                    "ec_encode_hash", plat, b, data.nbytes
+                ) as rec:
+                    rec.pad(b, bucket)
+                    # the shard input is DONATED on device backends.  Host
+                    # numpy inputs survive donation (JAX donates the
+                    # transient device copy, never the host buffer), so
+                    # today's retry is safe either way — the rebind inside
+                    # the loop is the donation rule's retry idiom, kept
+                    # honest for the day a caller hands this path a
+                    # device-resident batch (ROADMAP item 2's AOT/pjit
+                    # migration), where attempt 1 WOULD consume the buffer
+                    with rec.transfer():
+                        x = pad_to_bucket(np.asarray(data), bucket)
+                    with rec.compute():
+                        parity, hashes = fn(self._enc_bitmat, x)
+                    with rec.transfer():
+                        parity, hashes = np.asarray(parity), np.asarray(hashes)
                 self._impl = impl
                 return parity[:b], hashes[:b]
             except Exception as e:  # noqa: BLE001 — fused path optional

@@ -209,12 +209,18 @@ def blake3_batch(x: np.ndarray) -> np.ndarray:
     asyncio.to_thread (lint rule `host-sync`, the scrub path does)."""
     b = x.shape[0]
     fn = _hasher_for_len(x.shape[1])
-    xp = pad_to_bucket(np.asarray(x), bucket_batch(b))
+    bucket = bucket_batch(b)
     with telemetry.dispatch(
         "blake3_hash", telemetry.resolved_platform(), b, x.nbytes
-    ):
-        # graft-lint: allow-donation(callers retain and re-read the host batch; the hasher also serves fused pipelines with long-lived inputs)
-        return np.asarray(fn(xp))[:b]
+    ) as rec:
+        rec.pad(b, bucket)
+        with rec.transfer():
+            xp = pad_to_bucket(np.asarray(x), bucket)
+        with rec.compute():
+            # graft-lint: allow-donation(callers retain and re-read the host batch; the hasher also serves fused pipelines with long-lived inputs)
+            out_dev = fn(xp)
+        with rec.transfer():
+            return np.asarray(out_dev)[:b]
 
 
 def blake3_batch_fn(length: int):

@@ -29,10 +29,41 @@ endpoint via utils/metrics.py; catalogued in doc/monitoring.md):
                                                  repair planner's batch
                                                  coalescing exists to
                                                  make this advance
+
+Codec X-ray families (ISSUE 17 — the instrument ROADMAP item 1's
+pjit/AOT/double-buffering rewrite aims with; catalogued in
+doc/monitoring.md §"Codec X-ray"):
+
+  tpu_codec_pad_requested_total{kernel}   batch rows callers asked for
+  tpu_codec_pad_padded_total{kernel}      batch rows actually dispatched
+                                          (after pow2 bucketing) — the
+                                          cumulative quotient is the
+                                          pad-waste fraction
+  tpu_codec_pad_waste{kernel}             cumulative pad-waste gauge,
+                                          1 - requested/padded
+  tpu_codec_transfer_duration{kernel}     host<->device marshalling secs
+                                          per dispatch (pad + fetch) (H)
+  tpu_codec_compute_duration{kernel}      on-device compute secs (H)
+  tpu_codec_overlap_efficiency{kernel}    EWMA of wall / (transfer +
+                                          compute) per dispatch — 1.0 =
+                                          strictly sequential phases
+                                          (today's truth); the
+                                          double-buffering rewrite must
+                                          push this DOWN, exactly like
+                                          PR 6's api_s3_overlap_efficiency
+                                          for the PUT pipeline
+  tpu_compile_duration{cache}             compile-event wall seconds (H):
+                                          one observation per
+                                          instrumented-cache miss AND per
+                                          first dispatch of a cold
+                                          (kernel, bucket) shape class —
+                                          count = compile events, sum =
+                                          total seconds lost to lowering
 """
 
 from __future__ import annotations
 
+import time
 from contextlib import contextmanager
 
 from ..utils.metrics import SIZE_BUCKETS, registry
@@ -40,6 +71,17 @@ from ..utils.metrics import SIZE_BUCKETS, registry
 registry.set_buckets("tpu_codec_batch_size", SIZE_BUCKETS)
 
 _platforms_seen: set[str] = set()
+
+# (kernel, padded-bucket) shape classes that have dispatched at least
+# once in this process: the first dispatch of a class pays XLA lowering
+# inside its wall time, so it is recorded as a compile event; repeats
+# are executable-cache hits and record nothing
+_shape_seen: set[tuple[str, int]] = set()
+
+# per-kernel overlap-efficiency EWMA state (same alpha as the latency
+# X-ray's PhaseAggregator, so the two gauges read on the same scale)
+EWMA_ALPHA = 0.2
+_overlap_ewma: dict[str, float] = {}
 
 
 def resolved_platform(pin: str | None = None) -> str:
@@ -103,15 +145,295 @@ def mesh_engaged(kernel: str, platform: str, devices: int) -> None:
     )
 
 
+def compile_event(cache: str, secs: float) -> None:
+    """Record one compile event (wall seconds lost to lowering) for a
+    cache/kernel family.  Two producers feed this histogram: the
+    instrumented-cache miss path (utils/compile_cache.py — jit/trace
+    construction) and the first dispatch of a cold (kernel, bucket)
+    shape class (DispatchRecord._finish — the XLA lowering a fresh
+    shape pays inside its first wall time)."""
+    registry.observe("tpu_compile_duration", (("cache", cache),), secs)
+
+
+def record_pad(kernel: str, requested: int, padded: int) -> None:
+    """Account one dispatch's bucket padding: `requested` batch rows
+    asked for, `padded` rows actually shipped.  The cumulative quotient
+    is the per-kernel pad-waste fraction (gauge `tpu_codec_pad_waste`),
+    bounded at 0.5 by pow2 bucketing — a value above that means a pad
+    path stopped routing through ops/bucketing.py."""
+    lbl = (("kernel", kernel),)
+    registry.incr("tpu_codec_pad_requested_total", lbl, float(requested))
+    registry.incr("tpu_codec_pad_padded_total", lbl, float(max(padded, requested)))
+    req = registry.counters[("tpu_codec_pad_requested_total", lbl)]
+    pad = registry.counters[("tpu_codec_pad_padded_total", lbl)]
+    if pad > 0:
+        registry.set_gauge(
+            "tpu_codec_pad_waste", lbl, round(1.0 - req / pad, 4)
+        )
+
+
+class DispatchRecord:
+    """Per-dispatch X-ray handle yielded by `dispatch()`: the call site
+    reports its pad geometry and brackets its transfer/compute phases;
+    the exit path turns those into pad-waste counters, the per-kernel
+    overlap-efficiency EWMA, and first-dispatch compile events."""
+
+    __slots__ = ("kernel", "platform", "requested", "padded",
+                 "transfer_secs", "compute_secs")
+
+    def __init__(self, kernel: str, platform: str):
+        self.kernel = kernel
+        self.platform = platform
+        self.requested: int | None = None
+        self.padded: int | None = None
+        self.transfer_secs = 0.0
+        self.compute_secs = 0.0
+
+    def pad(self, requested: int, padded: int) -> None:
+        """Report this dispatch's batch geometry (first call wins: a
+        mesh attempt that fell back must not double-count its pad)."""
+        if self.requested is not None:
+            return
+        self.requested, self.padded = int(requested), int(padded)
+        record_pad(self.kernel, requested, padded)
+
+    @contextmanager
+    def transfer(self):
+        """Bracket host<->device marshalling (pad copy, device_put, the
+        blocking fetch back to numpy)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.transfer_secs += dt
+            registry.observe(
+                "tpu_codec_transfer_duration", (("kernel", self.kernel),), dt
+            )
+
+    @contextmanager
+    def compute(self):
+        """Bracket the device call itself (enqueue on async backends —
+        the fetch in `transfer()` absorbs the wait, which is exactly the
+        sequential-phases truth the overlap gauge reports)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.compute_secs += dt
+            registry.observe(
+                "tpu_codec_compute_duration", (("kernel", self.kernel),), dt
+            )
+
+    def _finish(self, wall: float) -> None:
+        # first dispatch of a cold (kernel, bucket) shape class pays XLA
+        # lowering inside `wall`; repeats are executable-cache hits and
+        # record no compile time (asserted by tests/test_codec_xray.py).
+        # The native "host" paths have no lowering step at all, so they
+        # never produce shape-class compile events.
+        if self.padded is not None and self.platform != "host":
+            key = (self.kernel, self.padded)
+            if key not in _shape_seen:
+                _shape_seen.add(key)
+                compile_event(self.kernel, wall)
+        phases = self.transfer_secs + self.compute_secs
+        if phases > 0 and wall > 0:
+            eff = wall / phases
+            prev = _overlap_ewma.get(self.kernel)
+            ewma = eff if prev is None else (
+                EWMA_ALPHA * eff + (1 - EWMA_ALPHA) * prev
+            )
+            _overlap_ewma[self.kernel] = ewma
+            registry.set_gauge(
+                "tpu_codec_overlap_efficiency",
+                (("kernel", self.kernel),), round(ewma, 4),
+            )
+
+
 @contextmanager
 def dispatch(kernel: str, platform: str, batch: int, nbytes: int):
     """Instrument one device dispatch: counters + batch-size histogram on
-    entry, duration histogram (and `_errors` counter, via the registry
-    timer) around the body."""
+    entry, duration histogram (and `_errors` counter, matching the
+    registry-timer contract) around the body.  Yields a DispatchRecord
+    the call site MAY feed pad geometry and transfer/compute phases —
+    plain `with dispatch(...):` callers keep working unchanged."""
     lbl = (("kernel", kernel), ("platform", platform))
     registry.incr("tpu_codec_dispatch_total", lbl)
     registry.incr("tpu_codec_bytes_total", lbl, nbytes)
     registry.observe("tpu_codec_batch_size", (("kernel", kernel),), float(batch))
     note_platform(platform)
-    with registry.timer("tpu_codec_dispatch_duration", lbl):
-        yield
+    rec = DispatchRecord(kernel, platform)
+    t0 = time.perf_counter()
+    try:
+        yield rec
+    except BaseException:
+        registry.observe(
+            "tpu_codec_dispatch_duration", lbl, time.perf_counter() - t0
+        )
+        registry.incr("tpu_codec_dispatch_duration_errors", lbl)
+        raise
+    wall = time.perf_counter() - t0
+    registry.observe("tpu_codec_dispatch_duration", lbl, wall)
+    rec._finish(wall)
+
+
+def reset_xray_state() -> None:
+    """Drop the process-wide shape-class and EWMA state (tests that
+    assert cold-class compile accounting need a cold process view)."""
+    _shape_seen.clear()
+    _overlap_ewma.clear()
+
+
+def _finite_quantile(q: float | None) -> float | None:
+    """Histogram quantiles above the top bucket come back as +Inf, which
+    is not JSON-able; clamp to 2x the largest latency bucket bound so
+    the snapshot stays serializable while still reading as 'way over'."""
+    if q is None:
+        return None
+    return min(q, 16.384)
+
+
+def codec_snapshot(r=None) -> dict:
+    """One JSON-able view of the codec X-ray, computed from a metrics
+    registry (default: the process registry).  The SINGLE source the
+    digest `codec.*` keys, `GET /v1/codec`, the admin-RPC `codec` op and
+    bench.py's `detail.codec` all read, so the same numbers appear on
+    every surface (the acceptance bar for ISSUE 17)."""
+    r = r or registry
+    req = r.counter_family_sum("tpu_codec_pad_requested_total")
+    pad = r.counter_family_sum("tpu_codec_pad_padded_total")
+    cm = r.family_merge("tpu_compile_duration")
+    ll99 = _finite_quantile(
+        r.family_quantile("block_codec_batch_lane_linger", 0.99)
+    )
+    kernels: dict[str, dict] = {}
+    for (name, labels), v in sorted(r.counters.items()):
+        if name not in (
+            "tpu_codec_pad_requested_total", "tpu_codec_pad_padded_total"
+        ):
+            continue
+        kern = dict(labels).get("kernel", "")
+        k = kernels.setdefault(
+            kern, {"requested": 0, "padded": 0, "padWaste": 0.0,
+                   "overlapEfficiency": None},
+        )
+        field = "requested" if name.endswith("requested_total") else "padded"
+        k[field] += int(v)
+    ovls = []
+    for kern, k in kernels.items():
+        if k["padded"]:
+            k["padWaste"] = round(1.0 - k["requested"] / k["padded"], 4)
+        g = r.gauges.get(
+            ("tpu_codec_overlap_efficiency", (("kernel", kern),))
+        )
+        if g is not None:
+            k["overlapEfficiency"] = round(g, 4)
+            ovls.append(g)
+    compile_by_cache: dict[str, dict] = {}
+    for (name, labels), (cnt, total, _b) in sorted(r.durations.items()):
+        if name != "tpu_compile_duration":
+            continue
+        cache = dict(labels).get("cache", "")
+        compile_by_cache[cache] = {
+            "events": int(cnt), "secs": round(total, 6),
+        }
+    lanes: dict[str, dict] = {}
+    for (name, labels), (cnt, total, _b) in sorted(r.durations.items()):
+        if name != "block_codec_batch_lane_linger":
+            continue
+        ld = dict(labels)
+        lane = lanes.setdefault(ld.get("lane", ""), {"flush": {}})
+        p99 = _finite_quantile(r.quantile(name, labels, 0.99))
+        lane["flush"][ld.get("flush", "")] = {
+            "blocks": int(cnt),
+            "lingerSecsTotal": round(total, 6),
+            "lingerP99": round(p99, 6) if p99 is not None else None,
+        }
+    return {
+        "dispatches": int(r.counter_family_sum("tpu_codec_dispatch_total")),
+        "padWaste": round(1.0 - req / pad, 4) if pad else 0.0,
+        "compileEvents": int(cm[0]) if cm else 0,
+        "compileSecs": round(cm[1], 6) if cm else 0.0,
+        "overlapEfficiency": (
+            round(sum(ovls) / len(ovls), 4) if ovls else 0.0
+        ),
+        "laneLingerP99": round(ll99, 6) if ll99 is not None else 0.0,
+        "platforms": platforms_seen(),
+        "kernels": kernels,
+        "compile": compile_by_cache,
+        "lanes": lanes,
+    }
+
+
+# newest probe profile, parsed once per (path, mtime) — probes are
+# banked by bench runs, not by the daemon, so this ~never invalidates
+_probe_cache: dict = {}
+
+
+def probe_failure_summary(root: str | None = None) -> dict | None:
+    """Newest banked TPU probe wedge profile (bench.py phased_probe,
+    ISSUE 11: `tpu_runs/probe_profile_*.json`), reduced to the verdict
+    line `garage stats` / `cluster top` print: the structured
+    failure_reason — which phase stuck, rc, timeout, stderr evidence
+    length — instead of "wedged at devices" folklore.  None when no
+    profile is banked (CPU dev boxes, or a probe that has only ever
+    succeeded — success banks no profile)."""
+    import glob
+    import json
+    import os
+
+    if root is None:
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    paths = sorted(
+        glob.glob(os.path.join(root, "tpu_runs", "probe_profile_*.json"))
+    )
+    if not paths:
+        return None
+    path = paths[-1]
+    try:
+        key = (path, os.path.getmtime(path))
+        if _probe_cache.get("key") == key:
+            return _probe_cache["summary"]
+        # graft-lint: allow-blocking(one small banked JSON artifact, read once per (path, mtime) then served from cache)
+        with open(path) as f:
+            prof = json.load(f)
+    except (OSError, ValueError):
+        return None
+    fr = prof.get("failure_reason")
+    if not fr:
+        # pre-ISSUE-11 profile: derive the reason the way phased_probe
+        # now does — the bracket child that targeted the wedged phase
+        # carries the stderr evidence, the full run is the fallback
+        wedged = prof.get("wedged_at")
+        culprit = next(
+            (
+                b
+                for b in prof.get("brackets", [])
+                if b.get("phase_arg") == wedged
+            ),
+            prof.get("full") or {},
+        )
+        fr = {
+            "phase": wedged,
+            "rc": culprit.get("rc"),
+            "timed_out": culprit.get("rc") == "TIMEOUT",
+            "dt": culprit.get("dt"),
+            "stderr_tail": culprit.get("stderr_tail", ""),
+        }
+    summary = {
+        "result": prof.get("result")
+        or ("wedged" if prof.get("wedged_at") else "failed"),
+        "wedgedAt": prof.get("wedged_at"),
+        "phase": fr.get("phase"),
+        "rc": fr.get("rc"),
+        "timedOut": bool(fr.get("timed_out")),
+        "dt": fr.get("dt"),
+        "stderrTail": (fr.get("stderr_tail") or "")[-400:],
+        "utc": prof.get("utc"),
+        "profile": os.path.basename(path),
+    }
+    _probe_cache["key"], _probe_cache["summary"] = key, summary
+    return summary

@@ -181,8 +181,13 @@ class DigestCollector:
         )
         resync_age = g.block_manager.resync.oldest_error_age_secs()
 
-        from ..ops.telemetry import platforms_seen
+        from ..ops.telemetry import codec_snapshot, platforms_seen
 
+        # codec X-ray (ops/telemetry.py): dispatch pad-waste, compile
+        # accounting, host<->device overlap, batcher lane linger — the
+        # same snapshot the admin /v1/codec endpoint serves, reduced to
+        # its scalar summary for gossip
+        cx = codec_snapshot(r)
         digest: dict[str, Any] = {
             "v": DIGEST_VERSION,
             "up": round(now - self.started_at, 3),
@@ -218,6 +223,16 @@ class DigestCollector:
             "tpu": {
                 "dps": round(rates["tpu_disp"], 4),
                 "plat": ",".join(platforms_seen()) or None,
+            },
+            # codec X-ray summary (ISSUE 17) — "codec" keys are additive,
+            # DIGEST_VERSION stays 1
+            "codec": {
+                "dsp": cx["dispatches"],
+                "pw": cx["padWaste"],
+                "ce": cx["compileEvents"],
+                "cs": cx["compileSecs"],
+                "ovl": cx["overlapEfficiency"],
+                "ll99": cx["laneLingerP99"],
             },
         }
         # canary prober health (api/s3/canary.py): cumulative probes,
@@ -619,6 +634,16 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
             "workerErrors": dsum("work", "errs"),
             "breakersOpen": dsum("rpc", "open"),
             "tpuDispatchPerSec": round(dsum("tpu", "dps"), 4),
+            # codec X-ray: dispatches sum exactly (per-node cumulative
+            # counters); pad-waste and overlap are worst-over-nodes (the
+            # triage question is "is ANY node wasting its accelerator"),
+            # compile events/seconds sum (cluster-wide recompile burden)
+            "codecDispatches": dsum("codec", "dsp"),
+            "codecPadWasteWorst": dmax("codec", "pw"),
+            "codecCompileEvents": dsum("codec", "ce"),
+            "codecCompileSeconds": round(dsum("codec", "cs"), 4),
+            "codecOverlapEfficiencyWorst": dmax("codec", "ovl"),
+            "codecLaneLingerP99SecondsWorst": dmax("codec", "ll99"),
             # durability observatory: per-node counts are OWNED blocks,
             # so sums are exact cluster totals; min-redundancy is the
             # min over nodes (distance from data loss), ETA the max
@@ -641,6 +666,87 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
         },
         "outliers": outliers,
         "slo": slo,
+        # newest banked TPU probe wedge verdict (bench.py phased_probe,
+        # ISSUE 11): per-box, so this is the ANSWERING node's probe
+        # history — null on boxes whose probe never failed
+        "tpuProbe": _probe_summary(),
+    }
+
+
+def _probe_summary():
+    from ..ops.telemetry import probe_failure_summary
+
+    return probe_failure_summary()
+
+
+def codec_response(garage) -> dict:
+    """The one serialization of the codec X-ray, shared by admin
+    `GET /v1/codec`, the admin-RPC `codec` op and the `cluster codec` /
+    `codec top` CLI (key casing cannot drift between transports).
+
+    `local` is the full ops/telemetry.codec_snapshot — per-kernel pad
+    accounting, per-cache compile events, per-lane linger — read from
+    this node's own registry.  Cluster rows come from the gossiped
+    `codec.*` digest keys, so any node answers for all; a digest-less
+    old peer renders `codec: null`, never an error.  Rows are NOT
+    filtered to connected peers: the fields are cumulative process
+    counters, and a dead peer's last-known compile/pad numbers are
+    still the right triage input (unlike durability, nothing here is
+    re-owned on failure, so nothing double-counts)."""
+    from ..ops.telemetry import codec_snapshot
+
+    system = garage.system
+    system.expire_node_status()
+    local = _valid_digest(garage.telemetry.collect()) or {}
+    rows = [
+        {
+            "id": system.id.hex(),
+            "isSelf": True,
+            "isUp": True,
+            "codec": local.get("codec"),
+        }
+    ]
+    for pid, (pst, _ts) in sorted(system.node_status.items()):
+        d = _valid_digest(pst.telemetry) or {}
+        rows.append(
+            {
+                "id": pid.hex(),
+                "isSelf": False,
+                "isUp": system.netapp.is_connected(pid),
+                "codec": d.get("codec"),
+            }
+        )
+    with_codec = [r for r in rows if isinstance(r.get("codec"), dict)]
+
+    def nsum(key: str) -> float:
+        return sum(_num(r["codec"].get(key), 0.0) or 0.0 for r in with_codec)
+
+    def nmax(key: str) -> float | None:
+        vals = [
+            v
+            for r in with_codec
+            if (v := _num(r["codec"].get(key))) is not None
+        ]
+        return max(vals) if vals else None
+
+    return {
+        "node": garage.node_id.hex(),
+        "local": codec_snapshot(garage.telemetry.registry),
+        "cluster": {
+            "nodes": rows,
+            "nodesReporting": len(with_codec),
+            "aggregate": {
+                # sums are exact (cumulative per-process counters);
+                # waste/overlap/linger take the worst node — the triage
+                # question is "is ANY node wasting its accelerator"
+                "dispatches": nsum("dsp"),
+                "padWasteWorst": nmax("pw"),
+                "compileEvents": nsum("ce"),
+                "compileSeconds": round(nsum("cs"), 4),
+                "overlapEfficiencyWorst": nmax("ovl"),
+                "laneLingerP99SecondsWorst": nmax("ll99"),
+            },
+        },
     }
 
 
@@ -735,6 +841,25 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
     ("cluster_node_layout_sync_fraction",
      "fraction of partitions synced to the current layout version",
      ("dur", "lt")),
+    # codec X-ray (ISSUE 17, ops/telemetry.py codec_snapshot): dispatch
+    # pad-waste, compile accounting, transfer/compute overlap, batcher
+    # lane linger — per-kernel breakdowns stay in /v1/codec JSON, only
+    # node-level scalars federate
+    ("cluster_node_codec_dispatch_total",
+     "cumulative device codec dispatches", ("codec", "dsp")),
+    ("cluster_node_codec_pad_waste",
+     "fraction of dispatched rows that were bucket padding",
+     ("codec", "pw")),
+    ("cluster_node_codec_compile_events",
+     "cumulative compile events (cache misses + first-shape lowerings)",
+     ("codec", "ce")),
+    ("cluster_node_codec_compile_seconds",
+     "cumulative wall seconds spent compiling", ("codec", "cs")),
+    ("cluster_node_codec_overlap_efficiency",
+     "wall over transfer-plus-compute (1.0 = fully sequential phases)",
+     ("codec", "ovl")),
+    ("cluster_node_codec_lane_linger_p99_seconds",
+     "batcher lane linger p99 (arrival to dispatch)", ("codec", "ll99")),
     # metadata plane (ISSUE 15): effective table replication factor +
     # quorum sizes — a node whose meta RF disagrees with the cluster
     # stands out on one federated scrape

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".xla_cache")
@@ -50,12 +51,25 @@ def record_cache_event(cache: str, hit: bool) -> None:
     )
 
 
+def record_compile_secs(cache: str, secs: float) -> None:
+    """One compile event's wall seconds into `tpu_compile_duration{cache}`
+    (histogram count = compile events, sum = total lowering seconds —
+    the Codec X-ray's compile budget, doc/monitoring.md §"Codec X-ray").
+    A cache HIT must never reach here: hits record no compile time, and
+    tests/test_codec_xray.py asserts exactly that."""
+    from .metrics import registry
+
+    registry.observe("tpu_compile_duration", (("cache", cache),), secs)
+
+
 def instrumented_cache(cache_name: str):
-    """lru_cache-style memoizer that counts hits/misses per family.
+    """lru_cache-style memoizer that counts hits/misses per family AND
+    times the miss path as a compile event.
 
     Used for the in-process jit/trace caches (ec kernels, blake3
     hashers): a process that keeps missing these is recompiling — exactly
-    the wedge mode the persistent cache exists to kill, now measurable."""
+    the wedge mode the persistent cache exists to kill, now measurable
+    both as a count (miss storm) and as wall seconds lost."""
 
     def deco(fn):
         memo: dict = {}
@@ -66,7 +80,9 @@ def instrumented_cache(cache_name: str):
             hit = key in memo
             record_cache_event(cache_name, hit)
             if not hit:
+                t0 = time.perf_counter()
                 memo[key] = fn(*args, **kwargs)
+                record_compile_secs(cache_name, time.perf_counter() - t0)
             return memo[key]
 
         wrapper.cache_clear = memo.clear  # type: ignore[attr-defined]

@@ -65,6 +65,12 @@ class AdminConfig:
     # event-loop watchdog: scheduling-lag histogram + blocked-loop task
     # dumps; 0 disables
     event_loop_watchdog_threshold_msec: float = 250.0
+    # stall auto-capture (utils/profiler.StallProfiler): when the
+    # watchdog counts a stall, sample the wedged process for a burst and
+    # attach the top stacks to a `loop-stall-profile` flight event —
+    # opt-in, the capture burns ~0.25 s of watchdog-thread time per
+    # (rate-limited) episode
+    stall_profile: bool = False
     # SLO tracker (rpc/telemetry_digest.py SloTracker): S3 availability
     # target (percent of requests answered without a 5xx) and p99
     # latency target, both accounted over a rolling window -> the

@@ -42,66 +42,21 @@ import time
 
 from .metrics import registry
 
+# the profiler grew into its own module (utils/profiler.py, ISSUE 17);
+# re-exported here unchanged so existing flight.profile(...) callers —
+# admin HTTP, admin RPC, CLI, tests — keep working
+from .profiler import (  # noqa: F401 — re-exports are this module's API
+    ProfileResult,
+    SamplingProfiler,
+    _all_tasks,
+    _format_frame,
+    _task_frames,
+    _task_label,
+    _thread_stack,
+    profile,
+)
+
 logger = logging.getLogger("garage.flight")
-
-# --- stack formatting helpers -------------------------------------------------
-
-
-def _format_frame(frame) -> str:
-    code = frame.f_code
-    path = code.co_filename.replace("\\", "/").split("/")
-    short = "/".join(path[-2:])
-    # ';' is the folded-stack separator — keep it out of frame names
-    name = code.co_name.replace(";", ",")
-    return f"{name} ({short}:{frame.f_lineno})"
-
-
-def _thread_stack(frame) -> list[str]:
-    """Leaf frame -> root-first formatted stack."""
-    out: list[str] = []
-    while frame is not None:
-        out.append(_format_frame(frame))
-        frame = frame.f_back
-    out.reverse()
-    return out
-
-
-def _task_frames(task) -> list:
-    """Outermost-first suspended frames of an asyncio task, walking the
-    cr_await chain.  Empty for a currently-RUNNING task (its frames show
-    up in `sys._current_frames()` instead)."""
-    frames = []
-    coro = task.get_coro()
-    seen = 0
-    while coro is not None and seen < 64:
-        seen += 1
-        fr = getattr(coro, "cr_frame", None) or getattr(coro, "gi_frame", None)
-        if fr is None:
-            break  # running (or closed): the thread sampler owns it
-        frames.append(fr)
-        coro = getattr(coro, "cr_await", None) or getattr(coro, "gi_yieldfrom", None)
-    return frames
-
-
-def _task_label(task) -> str:
-    coro = task.get_coro()
-    name = getattr(coro, "__qualname__", None) or task.get_name()
-    return f"task:{name}".replace(";", ",")
-
-
-def _all_tasks(loop) -> set:
-    """asyncio.all_tasks from another thread: the WeakSet can mutate
-    mid-iteration on a live loop; retry a few times, give up quietly
-    (a wedged loop — the interesting case — cannot mutate it)."""
-    for _ in range(4):
-        try:
-            return asyncio.all_tasks(loop)
-        except RuntimeError:
-            continue
-        # graft-lint: allow-swallow(diagnostics must never raise; sampler gives up quietly)
-        except Exception:  # noqa: BLE001 — diagnostics must never raise
-            break
-    return set()
 
 
 def _task_trace_id(task) -> str:
@@ -139,126 +94,6 @@ def _task_trace_id(task) -> str:
         return ""
 
 
-# --- sampling profiler --------------------------------------------------------
-
-
-class ProfileResult:
-    """Aggregated collapsed stacks from one profiling run."""
-
-    def __init__(self, hz: int):
-        self.hz = hz
-        self.samples = 0  # sampling rounds completed
-        self.stacks: collections.Counter = collections.Counter()
-
-    def add(self, stack: tuple[str, ...]) -> None:
-        self.stacks[stack] += 1
-
-    def folded(self) -> str:
-        """flamegraph.pl / speedscope folded-stack text, hottest first."""
-        lines = [
-            f"{';'.join(stack)} {count}"
-            for stack, count in sorted(
-                self.stacks.items(), key=lambda kv: -kv[1]
-            )
-        ]
-        return "\n".join(lines) + ("\n" if lines else "")
-
-    def speedscope(self) -> dict:
-        """speedscope 'sampled' profile (https://www.speedscope.app)."""
-        frame_index: dict[str, int] = {}
-        samples: list[list[int]] = []
-        weights: list[int] = []
-        for stack, count in self.stacks.items():
-            samples.append(
-                [frame_index.setdefault(f, len(frame_index)) for f in stack]
-            )
-            weights.append(count)
-        total = sum(weights)
-        return {
-            "$schema": "https://www.speedscope.app/file-format-schema.json",
-            "name": "garage-tpu profile",
-            "exporter": "garage-tpu flight recorder",
-            "activeProfileIndex": 0,
-            "shared": {"frames": [{"name": f} for f in frame_index]},
-            "profiles": [
-                {
-                    "type": "sampled",
-                    "name": f"{self.samples} rounds @ {self.hz} Hz",
-                    "unit": "none",
-                    "startValue": 0,
-                    "endValue": total,
-                    "samples": samples,
-                    "weights": weights,
-                }
-            ],
-        }
-
-
-class SamplingProfiler:
-    """One profiling run: a daemon thread sampling thread stacks + the
-    asyncio task set at `hz` until the deadline."""
-
-    def __init__(self, loop, hz: int = 100):
-        self.loop = loop
-        self.result = ProfileResult(hz)
-        self._stop = False
-        self._own_ident: int | None = None
-
-    def run(self, seconds: float) -> None:
-        self._own_ident = threading.get_ident()
-        interval = 1.0 / self.result.hz
-        deadline = time.monotonic() + seconds
-        while not self._stop and time.monotonic() < deadline:
-            self._sample()
-            time.sleep(interval)
-
-    def stop(self) -> None:
-        self._stop = True
-
-    def _sample(self) -> None:
-        res = self.result
-        res.samples += 1
-        names = {t.ident: t.name for t in threading.enumerate()}
-        for tid, frame in sys._current_frames().items():
-            if tid == self._own_ident:
-                continue
-            root = "thread:" + names.get(tid, str(tid)).replace(";", ",")
-            res.add(tuple([root] + _thread_stack(frame)))
-        # suspended asyncio tasks: where is everything parked?
-        for task in _all_tasks(self.loop):
-            try:
-                frames = _task_frames(task)
-            # graft-lint: allow-swallow(profiler samples at ~100 Hz; a vanished task is not news)
-            except Exception:  # noqa: BLE001
-                continue
-            if not frames:
-                continue  # running task, covered by the thread sample
-            res.add(
-                tuple([_task_label(task)] + [_format_frame(f) for f in frames])
-            )
-
-
-async def profile(seconds: float, hz: int = 100, loop=None) -> ProfileResult:
-    """Profile this process for `seconds` without blocking the loop.
-    Inputs are coerced and clamped here (seconds 0.05..60, hz 1..1000)
-    so the admin HTTP and RPC front-ends share one bounds policy."""
-    seconds = min(max(float(seconds), 0.05), 60.0)
-    loop = loop or asyncio.get_running_loop()
-    prof = SamplingProfiler(loop, hz=max(1, min(int(hz), 1000)))
-    t = threading.Thread(
-        target=prof.run, args=(float(seconds),),
-        name="garage-profiler", daemon=True,
-    )
-    t.start()
-    try:
-        while t.is_alive():
-            await asyncio.sleep(0.02)
-    finally:
-        prof.stop()
-        t.join(timeout=2.0)
-    return prof.result
-
-
 # --- event-loop watchdog ------------------------------------------------------
 
 
@@ -282,6 +117,10 @@ class EventLoopWatchdog:
         self.threshold = float(threshold)
         self.tick = float(tick)
         self.dump_interval = float(dump_interval)
+        # optional stall hook (utils/profiler.StallProfiler.on_stall when
+        # `[admin] stall_profile` is on): called once per counted episode,
+        # FROM THE MONITOR THREAD, while the loop is still wedged
+        self.on_stall = None
         self._loop = None
         self._loop_ident: int | None = None
         self._handle = None
@@ -335,6 +174,12 @@ class EventLoopWatchdog:
                     self._stalled = True
                     registry.incr("event_loop_blocked_total", ())
                     self._report(overdue)
+                    if self.on_stall is not None:
+                        try:
+                            self.on_stall(overdue, self._loop, self._loop_ident)
+                        # graft-lint: allow-swallow(stall diagnostics must never take the watchdog thread down)
+                        except Exception:  # noqa: BLE001
+                            pass
             else:
                 self._stalled = False
 

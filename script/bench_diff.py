@@ -134,6 +134,23 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         # 6.2 GB/s CPU-fallback encode = vs_baseline 0.62 (10 GB/s
         # baseline); the floor trips if encode falls below ~3 GB/s
         ("parsed.vs_baseline", ">=", 0.3, "EC(8,3) encode GB/s vs baseline"),
+        # codec X-ray (ISSUE 17): presence/shape floors — `>= 0` trips
+        # when the block vanishes or reshapes (missing path = violation)
+        ("parsed.detail.codec.pad_waste", ">=", 0.0,
+         "codec X-ray pad-waste banked"),
+        # pow2 bucketing can at worst pad just past a boundary (b = 2^n
+        # + 1 -> waste -> 0.5); the X-ray section's odd batches must
+        # never exceed it — above 0.5 the bucket ladder itself is broken
+        ("parsed.detail.codec.pad_waste", "<=", 0.5,
+         "pad waste bounded by the pow2 bucket ladder"),
+        ("parsed.detail.codec.compile_events", ">=", 1,
+         "compile accounting saw the X-ray section's cold shapes"),
+        ("parsed.detail.codec.compile_secs", ">=", 0.0,
+         "compile wall-time banked"),
+        ("parsed.detail.codec.overlap_efficiency", ">=", 0.01,
+         "overlap-efficiency gauge engaged (≈1.0 while sequential)"),
+        ("parsed.detail.codec.lane_linger_p99", ">=", 0.0,
+         "batcher lane-linger histogram banked"),
     ],
     "BENCH_s3_overload.json": [
         # overload-control plane (ISSUE 8): 4x burst on 11-node EC(8,3)

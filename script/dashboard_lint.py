@@ -59,9 +59,37 @@ HIST_SUFFIXES = ("_bucket", "_count", "_sum")
 # series.  A family may carry one of these labels only by declaring the
 # complete value set here (histogram `le` is the exposition's own).
 GUARDED_LABELS = ("key", "bucket")
+
+# codec X-ray label sets (ISSUE 17): every kernel name a dispatch site
+# passes and every compile-accounting cache label.  The compile family's
+# values are the instrumented-cache names PLUS the device kernel names
+# (a shape-class first dispatch attributes its lazy-lowering wall to the
+# kernel; instrumented_cache misses attribute trace time to the cache).
+_CODEC_KERNELS = frozenset({
+    "ec_encode", "ec_reconstruct", "ec_encode_hash",
+    "ec_encode_host", "ec_decode_host", "blake3_hash",
+})
+_COMPILE_CACHES = frozenset({
+    "blake3_hasher", "ec_apply", "ec_apply_legacy", "ec_apply_mesh",
+    "ec_encode_hash", "ec_batch_bucket", "ec_dispatch_bucket",
+    "ec_recon_matrix", "ec_encode", "ec_reconstruct", "blake3_hash",
+})
 BOUNDED_LABEL_VALUES: dict[str, dict[str, frozenset]] = {
-    # (none today: the admission plane's per-tenant gauges use the
-    # `tenant` label, which is LRU-bounded by config, not per-object)
+    # A family listed here has EVERY listed label enforced against its
+    # declared value set by lint_exposition (not just GUARDED_LABELS):
+    # growing a new kernel/cache/lane means enrolling it here, or the
+    # exposition lint fails — the declaration cannot silently rot.
+    "tpu_codec_pad_requested_total": {"kernel": _CODEC_KERNELS},
+    "tpu_codec_pad_padded_total": {"kernel": _CODEC_KERNELS},
+    "tpu_codec_pad_waste": {"kernel": _CODEC_KERNELS},
+    "tpu_codec_transfer_duration": {"kernel": _CODEC_KERNELS},
+    "tpu_codec_compute_duration": {"kernel": _CODEC_KERNELS},
+    "tpu_codec_overlap_efficiency": {"kernel": _CODEC_KERNELS},
+    "tpu_compile_duration": {"cache": _COMPILE_CACHES},
+    "block_codec_batch_lane_linger": {
+        "lane": frozenset({"encode", "decode"}),
+        "flush": frozenset({"full", "linger"}),
+    },
 }
 
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
@@ -161,11 +189,20 @@ def lint_exposition(text: str) -> dict[str, str]:
         name, labels = m.group(1), m.group(2) or ""
         float(m.group(3))
         base = base_family(name)
+        declared = BOUNDED_LABEL_VALUES.get(base, {})
         for lname, lval in _LABEL_RE.findall(labels):
+            if lname in declared:
+                # enrolled family: the label's value set is a contract
+                assert lval in declared[lname], (
+                    f"family {base} label {lname}={lval!r} is not in its "
+                    "declared value set — enroll the new value in "
+                    "BOUNDED_LABEL_VALUES (script/dashboard_lint.py) or "
+                    "it is unbounded cardinality in disguise"
+                )
+                continue
             if lname not in GUARDED_LABELS:
                 continue
-            allowed = BOUNDED_LABEL_VALUES.get(base, {}).get(lname)
-            assert allowed is not None and lval in allowed, (
+            assert False, (
                 f"family {base} carries a {lname!r} label "
                 f"(value {lval!r}) without a declared static value set "
                 "— per-object label cardinality is forbidden; serve "

@@ -37,9 +37,9 @@ def mesh_counter(monkeypatch):
     calls = []
     orig = EcTpu._apply_mesh
 
-    def wrapper(self, bitmat, x, n):
+    def wrapper(self, bitmat, x, n, rec=None):
         calls.append((x.shape, n))
-        return orig(self, bitmat, x, n)
+        return orig(self, bitmat, x, n, rec)
 
     monkeypatch.setattr(EcTpu, "_apply_mesh", wrapper)
     return calls
