@@ -266,6 +266,31 @@ class AdminApiServer:
 
             return web.json_response(durability_response(g))
 
+        if path == "/v1/cluster/transition" and request.method == "GET":
+            # rebalance observatory (rpc/transition.py): local transition
+            # flight deck (partition states, per-pair bytes, throughput,
+            # ETA, last report) + every node's gossiped lt.* digest +
+            # cluster aggregate (version spread, stale nodes, worst
+            # skew) — assembled from gossip, no fan-out needed
+            from ...rpc.transition import transition_response
+
+            return web.json_response(transition_response(g))
+
+        if path == "/v1/cluster/events" and request.method == "GET":
+            # federated event timeline (rpc/transition.py): fan out to
+            # every connected peer's flight-event bank and merge into
+            # one skew-corrected, causally-ordered timeline.
+            # ?since=<epoch secs> and ?min_severity=info|warn|critical
+            from ...rpc.transition import cluster_events_response
+
+            return web.json_response(
+                await cluster_events_response(
+                    g,
+                    since=float(request.query.get("since", 0) or 0),
+                    min_severity=request.query.get("min_severity", "info"),
+                )
+            )
+
         if path == "/v1/codec" and request.method == "GET":
             # codec X-ray (ops/telemetry.py + rpc/telemetry_digest.py):
             # local per-kernel pad accounting, compile events, overlap

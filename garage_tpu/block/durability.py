@@ -537,7 +537,13 @@ class DurabilityScanner(Worker):
             "examples": examples,
         }
         try:
-            flight.record_event(f"durability-alert:{worst}", attrs)
+            flight.record_event(
+                f"durability-alert:{worst}",
+                attrs,
+                severity=(
+                    "critical" if worst == DUR_UNREADABLE else "warn"
+                ),
+            )
         except Exception as e:  # noqa: BLE001 — the ledger must not die on diagnostics
             logger.debug("durability alert event failed: %r", e)
         logger.warning(

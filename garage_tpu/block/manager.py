@@ -36,6 +36,7 @@ import zstandard
 
 from ..db import Db
 from ..net.message import PRIO_BACKGROUND, PRIO_NORMAL, Req, Resp
+from ..rpc.layout.types import partition_of
 from ..rpc.rpc_helper import RpcHelper
 from ..rpc.system import System
 from ..utils.background import BackgroundRunner
@@ -997,10 +998,14 @@ class BlockManager:
         if meta.get("c"):
             stored = zstandard.decompress(stored)
         blen, data = unwrap_piece(stored)
-        self._note_piece_fetch(node, time.perf_counter() - t0, len(data))
+        self._note_piece_fetch(
+            node, time.perf_counter() - t0, len(data), hash32=hash32
+        )
         return blen, data
 
-    def _note_piece_fetch(self, node: bytes, secs: float, nbytes: int) -> None:
+    def _note_piece_fetch(
+        self, node: bytes, secs: float, nbytes: int, hash32: bytes | None = None
+    ) -> None:
         """Per-peer EC read attribution (rpc/traffic.py): the peer-health
         EWMAs feed the /v1/traffic slow-rank ranking, the histogram feeds
         the per-peer piece-fetch p99 Grafana panel.  The `peer` label is
@@ -1012,6 +1017,15 @@ class BlockManager:
         lbl = (("peer", node.hex()[:16]),)
         registry.observe("block_piece_fetch_duration", lbl, secs)
         registry.incr("block_piece_fetch_bytes_total", lbl, by=nbytes)
+        # rebalance observatory (rpc/transition.py): while a layout
+        # transition is open, inbound fetches are attributed to the
+        # (src -> dst) pair ledger — the tracker no-ops when idle
+        tt = getattr(self.system, "transition_tracker", None)
+        if tt is not None:
+            tt.note_transfer(
+                node, self.system.id, nbytes,
+                partition=partition_of(hash32) if hash32 else None,
+            )
 
     async def gather_pieces(
         self, hash32: bytes, want_k: int, prio=PRIO_NORMAL, exclude_self=False,

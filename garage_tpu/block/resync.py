@@ -21,6 +21,7 @@ import logging
 import os
 
 from ..net.message import PRIO_BACKGROUND
+from ..rpc.layout.types import partition_of
 from ..utils.backoff import expo
 from ..utils.background import BackgroundRunner, Worker, WorkerState
 from ..utils.time_util import now_msec
@@ -283,6 +284,17 @@ class BlockResyncManager:
                                     timeout=120.0,
                                     stream_factory=lambda: bytes_stream(stored),
                                     idempotent=True,
+                                )
+                            # rebalance observatory (rpc/transition.py):
+                            # attribute the outbound handoff to the
+                            # (self -> n) pair — no-op outside a transition
+                            tt = getattr(
+                                mgr.system, "transition_tracker", None
+                            )
+                            if tt is not None:
+                                tt.note_transfer(
+                                    mgr.system.id, n, len(stored),
+                                    partition=partition_of(hash32),
                                 )
                 except Exception as e:
                     raise RuntimeError(

@@ -655,6 +655,24 @@ class AdminRpcHandler:
 
         return codec_response(self.garage)
 
+    async def op_transition(self, args) -> Any:
+        """Rebalance observatory (rpc/transition.py): layout-transition
+        flight deck + cluster version spread — `cluster transition`."""
+        from ..rpc.transition import transition_response
+
+        return transition_response(self.garage)
+
+    async def op_cluster_events(self, args) -> Any:
+        """Federated event timeline (rpc/transition.py): skew-corrected
+        merge of every node's flight events — `cluster events`."""
+        from ..rpc.transition import cluster_events_response
+
+        return await cluster_events_response(
+            self.garage,
+            since=float(args.get("since") or 0.0),
+            min_severity=str(args.get("min_severity") or "info"),
+        )
+
     async def op_traffic(self, args) -> Any:
         """Traffic observatory (rpc/traffic.py): hot objects/buckets,
         op mix, skew, slow-peer ranking, cluster rollup — `cluster hot`."""

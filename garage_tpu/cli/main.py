@@ -23,6 +23,7 @@ import json
 import os
 import signal
 import sys
+import time
 
 from ..format_table import format_table
 from ..model.garage import Garage, _parse_addr, network_key_from_secret
@@ -178,6 +179,32 @@ def main(argv=None):
         "codec",
         help="codec X-ray: dispatch pad waste, compile events, overlap "
         "efficiency, batcher lane linger (ops/telemetry.py)",
+    )
+    clu_sub.add_parser(
+        "transition",
+        help="rebalance observatory: layout-transition flight deck, "
+        "version spread, per-pair bytes moved (rpc/transition.py)",
+    )
+    cev = clu_sub.add_parser(
+        "events",
+        help="federated cluster event timeline: every node's flight "
+        "events merged skew-corrected (rpc/transition.py)",
+    )
+    cev.add_argument(
+        "--since", type=float, default=0.0,
+        help="only events after this epoch timestamp (seconds)",
+    )
+    cev.add_argument(
+        "--min-severity", choices=["info", "warn", "critical"],
+        default="info", help="severity floor for the timeline",
+    )
+    cev.add_argument(
+        "--follow", action="store_true",
+        help="poll and stream new events until interrupted",
+    )
+    cev.add_argument(
+        "-n", "--interval", type=float, default=2.0,
+        help="poll interval in seconds with --follow",
     )
 
     cdx = sub.add_parser(
@@ -506,6 +533,16 @@ def _render_cluster_top(r: dict) -> str:
             f"{agg.get('codecCompileEvents', 0):g} compiles "
             f"({agg.get('codecCompileSeconds', 0):g}s)"
         )
+    # rebalance observatory (rpc/transition.py): version spread + how
+    # many nodes see an open transition, from the gossiped lt.* keys
+    if agg.get("layoutVersionSpread") or agg.get("layoutNodesInTransition"):
+        skw = agg.get("clockSkewWorstMs")
+        head.append(
+            f"layout\tversion spread {agg.get('layoutVersionSpread', 0):g}, "
+            f"{agg.get('layoutNodesInTransition', 0):g} node(s) in "
+            "transition, worst skew "
+            f"{'-' if skw is None else f'{skw:.0f}ms'}"
+        )
     # TPU probe verdict (bench.py phased_probe, ISSUE 11): the answering
     # box's newest banked wedge profile — structured evidence, not
     # "wedged at devices" folklore
@@ -518,8 +555,10 @@ def _render_cluster_top(r: dict) -> str:
             + f", banked {probe.get('utc')})"
         )
     out = format_table(head) + "\n\n"
+    skew_warn = agg.get("clockSkewWarnMs") or 250.0
     rows = [
-        "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry\thot\tflags"
+        "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry"
+        "\thot\tlayv\tflags"
     ]
     for n in r.get("nodes", []):
         d = n.get("digest") or {}
@@ -546,6 +585,19 @@ def _render_cluster_top(r: dict) -> str:
         nm = d.get("meta")
         if self_meta and nm and nm.get("rf") != self_meta.get("rf"):
             flags.append(f"META-RF={nm.get('rf')}!")
+        # rebalance observatory: the node's acked layout version ("*"
+        # while it still sees 2+ active versions); SKEW! when its clock
+        # offset exceeds the threshold — past that, the merged event
+        # timeline's ordering is not trustworthy
+        lt = d.get("lt") or {}
+        sk = lt.get("sk")
+        if sk is not None and abs(sk) > skew_warn:
+            flags.append("SKEW!")
+        layv = (
+            f"v{lt.get('ack')}" + ("*" if (lt.get("act") or 0) >= 2 else "")
+            if lt.get("ack") is not None
+            else "-"
+        )
         # canary column: probe p99 + cumulative failures, "-" when the
         # node runs no prober (or hasn't probed yet)
         cnry = (
@@ -565,7 +617,7 @@ def _render_cluster_top(r: dict) -> str:
             f"{_ms(s3.get('p99'))}\t{_ms((d.get('loop') or {}).get('p99'))}\t"
             f"{(d.get('resync') or {}).get('q', 0)}\t"
             f"{(d.get('rpc') or {}).get('open', 0)}\t"
-            f"{cnry}\t{hot}\t"
+            f"{cnry}\t{hot}\t{layv}\t"
             f"{','.join(flags) or '-'}"
         )
     out += format_table(rows)
@@ -751,6 +803,123 @@ def _render_cluster_codec(r: dict) -> str:
     return out
 
 
+def _render_cluster_transition(r: dict) -> str:
+    """`cluster transition`: the rebalance observatory as an operator
+    table — local flight deck (partition states, per-pair bytes,
+    throughput, ETA), then one row per node from the gossiped lt.*
+    digest keys (model: `cluster durability`)."""
+    agg = (r.get("cluster") or {}).get("aggregate") or {}
+    local = r.get("local") or {}
+    parts = local.get("partitions") or {}
+    skw = agg.get("clockSkewWorstMs")
+    thr = local.get("throughputBytesPerSec")
+    eta = local.get("etaSecs")
+    head = [
+        f"observatory\t{'enabled' if r.get('enabled') else 'DISABLED'}",
+        f"transition\t"
+        + (
+            f"OPEN (v{local.get('fromVersion')} -> v{local.get('version')}, "
+            f"{local.get('elapsedSecs', 0):g}s elapsed)"
+            if local.get("inTransition")
+            else f"idle at v{local.get('version')}"
+        ),
+        f"sync\t{(local.get('syncFraction') or 0) * 100:.1f}% "
+        f"({parts.get('synced', 0)}/{parts.get('total', 0)} synced, "
+        f"{parts.get('moving', 0)} moving, {parts.get('pending', 0)} pending)",
+        f"moved\t{local.get('bytesMoved', 0):g} B"
+        + (f" @ {thr:g} B/s" if thr else "")
+        + (f", eta {eta:g}s" if eta is not None else ""),
+        f"version spread\t{agg.get('versionSpread', 0):g} "
+        f"(newest v{agg.get('newestVersion')}, "
+        f"{agg.get('nodesReporting', 0)} reporting)",
+        f"stale nodes\t"
+        f"{', '.join(s[:16] for s in agg.get('staleNodes') or []) or '(none)'}",
+        f"clock skew\tworst {'-' if skw is None else f'{skw:g}ms'} "
+        f"(warn above {agg.get('clockSkewWarnMs'):g}ms)",
+    ]
+    rep = local.get("lastReport")
+    if rep:
+        head.append(
+            f"last report\tv{rep.get('version')} in "
+            f"{rep.get('durationSecs'):g}s, {rep.get('bytesMoved', 0):g} B "
+            f"over {len(rep.get('pairs') or [])} pair(s), "
+            f"slo burn max {rep.get('sloBurnMax')}, "
+            f"canary {'ok' if rep.get('canaryOk') else 'FAILED'}"
+        )
+    out = format_table(head) + "\n"
+    pairs = local.get("pairs") or []
+    if pairs:
+        rows = ["src\tdst\tbytes"]
+        for p in pairs[:16]:
+            rows.append(f"{p['src']}\t{p['dst']}\t{p['bytes']:g}")
+        out += "\n== bytes moved by pair ==\n" + format_table(rows) + "\n"
+    nodes = (r.get("cluster") or {}).get("nodes") or []
+    rows = ["id\tup\tver\tack\tsync\tactive\tfrac\tmoved\tskew"]
+    for n in nodes:
+        lt = n.get("lt")
+        if not isinstance(lt, dict):
+            rows.append(
+                f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+                "-\t-\t-\t-\t-\t-\tno-digest"
+            )
+            continue
+        sk = lt.get("sk")
+        frac = lt.get("frac")
+        rows.append(
+            f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+            f"{lt.get('v')}\t{lt.get('ack')}\t{lt.get('sync')}\t"
+            f"{lt.get('act')}\t"
+            f"{'-' if frac is None else f'{frac * 100:.0f}%'}\t"
+            f"{lt.get('mvb', 0):g}\t"
+            f"{'-' if sk is None else f'{sk:g}ms'}"
+        )
+    out += "\n== nodes ==\n" + format_table(rows)
+    return out
+
+
+def _render_event_lines(events: list) -> list[str]:
+    """One line per timeline event: corrected time, node, severity,
+    name, then the attrs (truncated — the JSON surface has them all)."""
+    lines = []
+    for e in events:
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted((e.get("attrs") or {}).items())
+        )
+        if len(attrs) > 120:
+            attrs = attrs[:117] + "..."
+        t = time.strftime(
+            "%H:%M:%S", time.localtime(e.get("time") or 0)
+        ) + f".{int(((e.get('time') or 0) % 1) * 1000):03d}"
+        lines.append(
+            f"{t}  {e.get('node', '?')[:16]}  "
+            f"{(e.get('severity') or 'info').upper():8s} "
+            f"{e.get('name')}  {attrs}"
+        )
+    return lines
+
+
+def _render_cluster_events(r: dict) -> str:
+    """`cluster events`: the federated timeline as text — header with
+    fan-out coverage, then the skew-corrected, causally-ordered lines."""
+    head = [
+        f"nodes\t{len(r.get('nodesResponding') or [])} responding"
+        + (
+            f", {len(r.get('nodesFailed') or [])} FAILED "
+            f"({', '.join(r.get('nodesFailed') or [])})"
+            if r.get("nodesFailed")
+            else ""
+        ),
+        f"filter\tsince {r.get('since', 0):g}, "
+        f"min severity {r.get('minSeverity', 'info')}",
+        f"events\t{len(r.get('events') or [])}",
+    ]
+    out = format_table(head)
+    lines = _render_event_lines(r.get("events") or [])
+    if lines:
+        out += "\n\n" + "\n".join(lines)
+    return out
+
+
 def _render_codec_top(r: dict) -> str:
     """`codec top`: this node's per-kernel dispatch economics — where
     the accelerator's batches pad, compile and linger (the `local` leg
@@ -922,6 +1091,46 @@ async def dispatch(args, call, config) -> str | None:
             return json.dumps(
                 await call("cluster-telemetry"), indent=2, default=repr
             )
+        if args.cluster_cmd == "transition":
+            r = await call("transition")
+            if args.json:
+                return json.dumps(r, indent=2, default=repr)
+            return _render_cluster_transition(r)
+        if args.cluster_cmd == "events":
+            a = {"since": args.since, "min_severity": args.min_severity}
+            if not args.follow:
+                r = await call("cluster-events", a)
+                if args.json:
+                    return json.dumps(r, indent=2, default=repr)
+                return _render_cluster_events(r)
+            # --follow: poll and stream only unseen events.  The server
+            # filters on each node's OWN clock, so the watermark lags
+            # one second behind the newest corrected time and a seen-set
+            # dedups the overlap (skew must not drop or repeat events).
+            seen: set = set()
+            try:
+                while True:
+                    r = await call("cluster-events", a)
+                    fresh = []
+                    for e in r.get("events") or []:
+                        k = (e.get("node"), e.get("rawTime"), e.get("name"))
+                        if k in seen:
+                            continue
+                        seen.add(k)
+                        fresh.append(e)
+                    for line in _render_event_lines(fresh):
+                        print(line, flush=True)
+                    if fresh:
+                        a["since"] = max(
+                            e.get("rawTime") or 0.0 for e in fresh
+                        ) - 1.0
+                        seen = {
+                            k for k in seen if k[1] >= a["since"]
+                        }
+                    await asyncio.sleep(max(0.2, args.interval))
+            # graft-lint: allow-cancel(interactive follow loop: ctrl-C is the exit gesture, the CLI returns to the shell)
+            except (KeyboardInterrupt, asyncio.CancelledError):
+                return None
         # cluster top: live table; --once (or --json) renders one frame
         if args.json:
             return json.dumps(

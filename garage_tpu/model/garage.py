@@ -504,6 +504,16 @@ class Garage:
 
         self.telemetry = DigestCollector(self)
         self.system.telemetry_collector = self.telemetry.collect
+        # rebalance observatory (rpc/transition.py): layout-transition
+        # flight deck + federated event timeline.  The events collector
+        # reads flight_recorder at call time — it is wired in start().
+        from ..rpc.transition import TransitionTracker, local_events
+
+        self.transition_tracker = TransitionTracker(self)
+        self.system.transition_tracker = self.transition_tracker
+        self.system.events_collector = lambda since, min_severity: (
+            local_events(self.flight_recorder, since, min_severity)
+        )
         self.slo_tracker = SloTracker(
             availability_target=config.admin.slo_availability_target,
             latency_target_msec=config.admin.slo_latency_p99_target_msec,

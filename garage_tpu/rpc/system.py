@@ -28,6 +28,7 @@ from ..utils.persister import Persister
 from .layout.manager import LayoutManager
 from .layout.types import N_PARTITIONS
 from .replication_mode import ReplicationMode
+from .transition import OFFSET_ALPHA, estimate_offset
 
 logger = logging.getLogger("garage.system")
 
@@ -145,6 +146,14 @@ class System:
         # DigestCollector.collect so every outgoing NodeStatus carries
         # the local digest (None = no collector, e.g. bare System tests)
         self.telemetry_collector = None
+        # rebalance observatory (rpc/transition.py): model/garage.py
+        # points these at its TransitionTracker / flight-event bank
+        self.transition_tracker = None
+        self.events_collector = None
+        # NTP-style per-peer clock offsets estimated from the status
+        # exchange: peer id -> {"offset": s, "rtt": s, "at": monotonic}
+        self.clock_offsets: dict[bytes, dict] = {}
+        self.wallclock = time.time  # injectable for skew tests
         self.status_expiry = NODE_STATUS_EXPIRY
         self._tasks: list[asyncio.Task] = []
         # coalesced layout gossip state (see _advertise_loop)
@@ -160,6 +169,8 @@ class System:
         self.pull_layout_ep.set_handler(self._handle_pull_layout)
         self.adv_layout_ep = netapp.endpoint("rpc/system/advertise_layout")
         self.adv_layout_ep.set_handler(self._handle_advertise_layout)
+        self.events_ep = netapp.endpoint("rpc/system/events")
+        self.events_ep.set_handler(self._handle_events)
         layout_manager.subscribe(self._on_layout_change)
 
     # --- lifecycle -----------------------------------------------------------
@@ -212,7 +223,36 @@ class System:
     async def _handle_status(self, from_id: bytes, req: Req) -> Resp:
         st = NodeStatus.from_obj(req.body)
         self._record_status(from_id, st)
-        return Resp(self.local_status().to_obj())
+        # the reply carries a fresh wall-clock stamp for the caller's
+        # NTP-style offset estimate (rpc/transition.py estimate_offset)
+        return Resp({**self.local_status().to_obj(), "ts": self.wallclock()})
+
+    def _note_peer_clock(
+        self, pid: bytes, t0: float, t_peer: float, t3: float
+    ) -> None:
+        """EWMA one NTP-style offset sample for a peer (one sample per
+        status exchange — the merged event timeline's ordering and the
+        `SKEW!` flag both hang off this estimate)."""
+        off, rtt = estimate_offset(t0, t_peer, t3)
+        prev = self.clock_offsets.get(pid)
+        if prev is not None:
+            off = OFFSET_ALPHA * off + (1 - OFFSET_ALPHA) * prev["offset"]
+            rtt = OFFSET_ALPHA * rtt + (1 - OFFSET_ALPHA) * prev["rtt"]
+        self.clock_offsets[pid] = {
+            "offset": off, "rtt": rtt, "at": time.monotonic()
+        }
+
+    async def _handle_events(self, from_id: bytes, req: Req) -> Resp:
+        """Federated event timeline (rpc/transition.py): serve this
+        node's banked flight events to a peer's admin fan-out."""
+        body = req.body if isinstance(req.body, dict) else {}
+        collector = self.events_collector
+        if collector is None:
+            return Resp([])
+        return Resp(collector(
+            since=float(body.get("since", 0.0) or 0.0),
+            min_severity=str(body.get("sev", "info")),
+        ))
 
     def _record_status(self, from_id: bytes, st: NodeStatus) -> None:
         self.node_status[from_id] = (st, time.monotonic())
@@ -307,10 +347,18 @@ class System:
 
         async def exchange(pid):
             try:
+                t0 = self.wallclock()
                 resp = await self.status_ep.call(
-                    pid, st, prio=PRIO_HIGH, timeout=10.0
+                    pid, {**st, "ts": t0}, prio=PRIO_HIGH, timeout=10.0
                 )
+                t3 = self.wallclock()
                 self._record_status(pid, NodeStatus.from_obj(resp.body))
+                ts = (
+                    resp.body.get("ts")
+                    if isinstance(resp.body, dict) else None
+                )
+                if ts is not None:
+                    self._note_peer_clock(pid, t0, float(ts), t3)
             except Exception as e:  # noqa: BLE001 — one dead peer must not
                 # stall the wave, but the miss is worth a debug line
                 logger.debug(
@@ -341,6 +389,7 @@ class System:
                 "aging out status of departed node %s", pid.hex()[:8]
             )
             del self.node_status[pid]
+            self.clock_offsets.pop(pid, None)
 
     async def _status_loop(self) -> None:
         while True:

@@ -286,6 +286,14 @@ class DigestCollector:
                 "rq": int(rep.read_quorum()),
                 "wq": int(rep.write_quorum()),
             }
+        # rebalance observatory (rpc/transition.py): this node's layout
+        # version / ack / sync trackers, transition progress and clock
+        # skew — "lt" keys are additive, DIGEST_VERSION stays 1.  The
+        # gossiped ack/sync versions are what let ANY node compute the
+        # cluster's version spread and per-node staleness.
+        tt = getattr(g, "transition_tracker", None)
+        if tt is not None:
+            digest["lt"] = tt.digest_fields()
         self._cached, self._cached_t = digest, now
         return digest
 
@@ -663,6 +671,30 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
                 if (_num(_dig(r, "dur", "mp"), 0.0) or 0.0) > 0
                 and _num(_dig(r, "dur", "eta")) is None
             ),
+            # rebalance observatory: version spread = newest layout
+            # version anyone knows minus the oldest ack anyone reports
+            # (0 = converged); worst |skew| bounds the merged event
+            # timeline's ordering error
+            "layoutVersionSpread": (
+                (dmax("lt", "v") or 0) - (dmin("lt", "ack") or 0)
+                if dmax("lt", "v") is not None
+                and dmin("lt", "ack") is not None
+                else 0
+            ),
+            "layoutNodesInTransition": sum(
+                1
+                for r in with_digest
+                if (_num(_dig(r, "lt", "act"), 0.0) or 0.0) >= 2
+            ),
+            "clockSkewWorstMs": max(
+                (
+                    abs(v)
+                    for r in with_digest
+                    if (v := _num(_dig(r, "lt", "sk"))) is not None
+                ),
+                default=None,
+            ),
+            "clockSkewWarnMs": garage.config.admin.clock_skew_warn_msec,
         },
         "outliers": outliers,
         "slo": slo,
@@ -869,6 +901,35 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
      "metadata-table read quorum", ("meta", "rq")),
     ("cluster_node_meta_write_quorum",
      "metadata-table write quorum", ("meta", "wq")),
+    # rebalance observatory (rpc/transition.py): each node's layout
+    # version / CRDT tracker positions + transition progress + the
+    # NTP-style clock skew the federated event timeline depends on —
+    # (src, dst) pair breakdowns stay in /v1/cluster/transition JSON
+    # and the node-local `layout_transition_pair_bytes_total` counter
+    ("cluster_node_layout_version",
+     "newest layout version the node knows", ("lt", "v")),
+    ("cluster_node_layout_ack_version",
+     "layout version the node has acked (CRDT ack tracker)",
+     ("lt", "ack")),
+    ("cluster_node_layout_sync_version",
+     "layout version the node has fully synced to (CRDT sync tracker)",
+     ("lt", "sync")),
+    ("cluster_node_layout_active_versions",
+     "layout versions with a ring assignment (2+ = transition open)",
+     ("lt", "act")),
+    ("cluster_node_layout_transition_bytes_moved",
+     "bytes moved by the node during the open layout transition",
+     ("lt", "mvb")),
+    ("cluster_node_layout_transition_throughput_bytes_per_second",
+     "EWMA rebalance ingest throughput during the open transition",
+     ("lt", "thr")),
+    ("cluster_node_layout_transition_eta_seconds",
+     "estimated seconds until the node sees sync fraction 1.0",
+     ("lt", "eta")),
+    ("cluster_node_clock_skew_ms",
+     "median NTP-style wall-clock offset vs peers (positive = peers "
+     "ahead); the merged event timeline's ordering error bound",
+     ("lt", "sk")),
 ]
 
 

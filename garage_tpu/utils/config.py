@@ -103,6 +103,11 @@ class AdminConfig:
     traffic_observatory: bool = True
     traffic_topk: int = 256
     traffic_halflife_secs: float = 600.0
+    # rebalance observatory (rpc/transition.py): |clock offset| above
+    # which a node gets the `SKEW!` flag in `cluster top` — beyond it
+    # the merged event timeline's ordering is not trustworthy at
+    # sub-threshold granularity
+    clock_skew_warn_msec: float = 250.0
 
 
 @dataclass
@@ -610,6 +615,10 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
         raise ValueError("traffic_topk must be >= 8")
     if float(cfg.admin.traffic_halflife_secs) <= 0:
         raise ValueError("traffic_halflife_secs must be > 0")
+    # rebalance observatory: a non-positive skew threshold would flag
+    # every node SKEW! on the first status exchange
+    if float(cfg.admin.clock_skew_warn_msec) <= 0:
+        raise ValueError("clock_skew_warn_msec must be > 0")
     # durability observatory knobs: a zero batch can never finish a
     # pass, a non-positive interval busy-loops full rc-tree walks
     du = cfg.durability

@@ -241,10 +241,18 @@ class SlowRequestRecorder:
     MAX_SPANS_PER_TRACE = 512
     PENDING_TTL = 30.0  # seconds a parentless subtree may linger
 
+    # flight events retained for the federated cluster timeline
+    # (rpc/transition.py) — a dedicated ring so a burst of slow
+    # requests cannot evict the durability alert an operator needs
+    EVENTS_TOP_K = 256
+
     def __init__(self, threshold_ms: float = 500.0, top_k: int = 64):
         self.threshold_ms = float(threshold_ms)
         self.top_k = int(top_k)
         self.records: collections.deque = collections.deque(maxlen=self.top_k)
+        self.events: collections.deque = collections.deque(
+            maxlen=self.EVENTS_TOP_K
+        )
         # trace id -> [last_touch_monotonic, [spans]]
         self.pending: dict[bytes, list] = {}
         self.dropped = 0  # spans discarded by the per-trace cap
@@ -486,20 +494,32 @@ def detach_recorder(rec: SlowRequestRecorder) -> None:
     span_fanout.detach(rec)
 
 
-def record_event(name: str, attrs: dict, recorder=None) -> None:
-    """Append a synthetic EVENT record to the slow-request ring(s).
+# severity ladder for flight events (rpc/transition.py ranks these for
+# `--min-severity` filtering; unknown strings clamp to "info")
+EVENT_SEVERITIES = ("info", "warn", "critical")
+
+
+def record_event(name: str, attrs: dict, recorder=None,
+                 severity: str = "info") -> None:
+    """Append a synthetic EVENT record to the slow-request ring(s) and
+    the dedicated event bank.
 
     Not a request: no span tree, zero duration, `ok: false` so the ring
     renderers surface it.  Used by planes that detect a state transition
     worth an operator's attention post-hoc — e.g. the durability
     observatory recording blocks entering `at_risk`/`unreadable`
-    (block/durability.py).  `recorder=None` fans out to every recorder
-    attached to the shared span fanout (all in-process nodes); pass one
-    explicitly for tests/ad-hoc tooling."""
+    (block/durability.py), or the rebalance observatory's
+    `transition-report` (rpc/transition.py).  `severity` is one of
+    info/warn/critical and rides into `/v1/cluster/events` filtering.
+    `recorder=None` fans out to every recorder attached to the shared
+    span fanout (all in-process nodes); pass one explicitly for
+    tests/ad-hoc tooling."""
+    sev = severity if severity in EVENT_SEVERITIES else "info"
     rec = {
         "traceId": "",
         "name": name,
         "event": True,
+        "severity": sev,
         "start": time.time(),
         "durationMs": 0.0,
         "ok": False,
@@ -507,9 +527,13 @@ def record_event(name: str, attrs: dict, recorder=None) -> None:
         "attrs": {k: str(v) for k, v in attrs.items()},
         "spans": [],
     }
+    registry.incr("flight_events_total", (("severity", sev),))
     targets = [recorder] if recorder is not None else list(span_fanout.recorders)
     for r in targets:
         r.records.append(rec)
+        events = getattr(r, "events", None)
+        if events is not None:
+            events.append(rec)
 
 
 def slow_response(recorder: "SlowRequestRecorder | None") -> dict:

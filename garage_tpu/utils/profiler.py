@@ -290,6 +290,7 @@ class StallProfiler:
                     "hz": res.hz,
                     "topStacks": "\n".join(res.top_stacks(self.top)),
                 },
+                severity="warn",
             )
         # graft-lint: allow-swallow(stall diagnostics must never take the watchdog thread down)
         except Exception:  # noqa: BLE001

@@ -152,6 +152,26 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         ("parsed.detail.codec.lane_linger_p99", ">=", 0.0,
          "batcher lane-linger histogram banked"),
     ],
+    "BENCH_layout_transition.json": [
+        # rebalance observatory (ISSUE 18): a 7→9 grow of a live
+        # EC(4,2) cluster, banked from the per-node TransitionTracker
+        # reports themselves.  The `>=` floors double as presence
+        # checks (a deleted/reshaped artifact fails loudly); the
+        # ceiling trips if the migration plane stalls — measured 118.6 s
+        # on the 1-CPU banking box (close is gated on every node's block
+        # resync drain + clean table sync rounds), so 300 s is headroom
+        # for box noise while still catching an indefinite stall.
+        ("transition_s", ">=", 0.01, "transition duration banked"),
+        ("transition_s", "<=", 300.0,
+         "grow-under-load transition closes promptly"),
+        ("bytes_moved", ">=", 1,
+         "migrated bytes attributed to (src→dst) pairs"),
+        ("sync_fraction_final", ">=", 1.0,
+         "every node converged to sync fraction 1.0"),
+        ("reports", ">=", 1, "transition-report banked on every node"),
+        ("events_nodes_failed", "<=", 0,
+         "federated event fan-out heard every node"),
+    ],
     "BENCH_s3_overload.json": [
         # overload-control plane (ISSUE 8): 4x burst on 11-node EC(8,3)
         # — measured 0.575 (admitted p99 1437 ms vs the 2500 ms SLO),

@@ -58,7 +58,10 @@ HIST_SUFFIXES = ("_bucket", "_count", "_sum")
 # (`/v1/traffic`, rpc/traffic.py) ONLY — never as per-key Prometheus
 # series.  A family may carry one of these labels only by declaring the
 # complete value set here (histogram `le` is the exposition's own).
-GUARDED_LABELS = ("key", "bucket")
+# `src`/`dst` carry node-id prefixes (bounded by cluster size, not object
+# count) and `severity` a three-value enum — guarded so a new family
+# cannot adopt them without declaring its bound below.
+GUARDED_LABELS = ("key", "bucket", "src", "dst", "severity")
 
 # codec X-ray label sets (ISSUE 17): every kernel name a dispatch site
 # passes and every compile-accounting cache label.  The compile family's
@@ -74,7 +77,13 @@ _COMPILE_CACHES = frozenset({
     "ec_encode_hash", "ec_batch_bucket", "ec_dispatch_bucket",
     "ec_recon_matrix", "ec_encode", "ec_reconstruct", "blake3_hash",
 })
-BOUNDED_LABEL_VALUES: dict[str, dict[str, frozenset]] = {
+# rebalance observatory (ISSUE 18): src/dst are hex node-id prefixes —
+# not statically enumerable, but bounded by cluster membership, so the
+# declared "set" is a shape contract (compiled regex) instead of a
+# frozenset.  lint_exposition accepts either form.
+_HEX16 = re.compile(r"[0-9a-f]{1,16}")
+_EVENT_SEVERITIES = frozenset({"info", "warn", "critical"})
+BOUNDED_LABEL_VALUES: dict[str, dict[str, object]] = {
     # A family listed here has EVERY listed label enforced against its
     # declared value set by lint_exposition (not just GUARDED_LABELS):
     # growing a new kernel/cache/lane means enrolling it here, or the
@@ -90,6 +99,8 @@ BOUNDED_LABEL_VALUES: dict[str, dict[str, frozenset]] = {
         "lane": frozenset({"encode", "decode"}),
         "flush": frozenset({"full", "linger"}),
     },
+    "layout_transition_pair_bytes_total": {"src": _HEX16, "dst": _HEX16},
+    "flight_events_total": {"severity": _EVENT_SEVERITIES},
 }
 
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
@@ -192,8 +203,16 @@ def lint_exposition(text: str) -> dict[str, str]:
         declared = BOUNDED_LABEL_VALUES.get(base, {})
         for lname, lval in _LABEL_RE.findall(labels):
             if lname in declared:
-                # enrolled family: the label's value set is a contract
-                assert lval in declared[lname], (
+                # enrolled family: the label's value set is a contract —
+                # a frozenset enumerates it, a compiled regex bounds its
+                # shape (node-id prefixes: bounded by membership)
+                allowed = declared[lname]
+                ok = (
+                    lval in allowed
+                    if isinstance(allowed, frozenset)
+                    else bool(allowed.fullmatch(lval))
+                )
+                assert ok, (
                     f"family {base} label {lname}={lval!r} is not in its "
                     "declared value set — enroll the new value in "
                     "BOUNDED_LABEL_VALUES (script/dashboard_lint.py) or "

@@ -181,6 +181,17 @@ async def set_worker(clients, ci, hist, stop):
         await asyncio.sleep(0.03)
 
 
+async def layout_change_nemesis(garages, settle=0.8):
+    """Layout reconfiguration under load: restage one node's role with a
+    halved capacity and apply — opens a real transition mid-workload.
+    Factored out of combined_nemesis so the rebalance-observatory tests
+    (tests/test_transition.py) can fire the same nemesis standalone."""
+    lm = garages[1].layout_manager
+    lm.stage_role(garages[0].node_id, NodeRole(zone="dc0", capacity=5 * 10**11))
+    lm.apply_staged()
+    await asyncio.sleep(settle)
+
+
 async def combined_nemesis(tmp_path, garages, servers, clients, key, mode="3"):
     """Partition + clock jumps + layout change + crash/restart, all in
     one run (the reference combines nemeses the same way)."""
@@ -191,11 +202,7 @@ async def combined_nemesis(tmp_path, garages, servers, clients, key, mode="3"):
     await asyncio.sleep(0.4)
     heal(garages)
 
-    # layout reconfiguration under load
-    lm = garages[1].layout_manager
-    lm.stage_role(garages[0].node_id, NodeRole(zone="dc0", capacity=5 * 10**11))
-    lm.apply_staged()
-    await asyncio.sleep(0.8)
+    await layout_change_nemesis(garages)
 
     set_clock_offset(-1_800_000)  # 30min BACKWARD
     await asyncio.sleep(0.4)

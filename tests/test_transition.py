@@ -1,0 +1,425 @@
+"""Rebalance observatory (rpc/transition.py): offset math, skewed
+timeline merge, TransitionTracker accounting, event-bank severity — and
+a slow 11→13 grow-under-load acceptance run.
+
+The tier-1 units drive the tracker against a REAL LayoutManager (the
+CRDT open/close edges are the contract under test); only the Garage
+shell around it is stubbed.  The slow test boots 13 in-process daemons,
+serves S3 traffic through the migration, and gates on the ISSUE's
+acceptance: sync fraction 1.0 with read-after-write green, a merged
+`/v1/cluster/events` timeline with every node reporting, and a banked
+transition-report whose bytes-moved total matches its per-pair counters.
+"""
+
+import asyncio
+import os
+import sys
+import time
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from garage_tpu.rpc.layout.manager import LayoutManager  # noqa: E402
+from garage_tpu.rpc.layout.types import NodeRole  # noqa: E402
+from garage_tpu.rpc.transition import (  # noqa: E402
+    TransitionTracker,
+    estimate_offset,
+    local_events,
+    merge_timeline,
+    severity_rank,
+)
+from garage_tpu.utils import flight  # noqa: E402
+
+
+# --- clock-offset estimation --------------------------------------------------
+
+
+def test_estimate_offset_recovers_known_skew():
+    # local sends at 100, peer (5.5 s ahead, symmetric 0.5 s each way)
+    # stamps 106.0 at the midpoint 100.5, local receives at 101
+    off, rtt = estimate_offset(100.0, 106.0, 101.0)
+    assert off == pytest.approx(5.5)
+    assert rtt == pytest.approx(1.0)
+    # peer BEHIND: negative offset
+    off, rtt = estimate_offset(200.0, 199.0, 200.2)
+    assert off == pytest.approx(-1.1)
+    # clock weirdness (t3 < t0, e.g. an NTP step mid-RPC) clamps rtt
+    _, rtt = estimate_offset(100.0, 100.0, 99.0)
+    assert rtt == 0.0
+
+
+def test_note_peer_clock_ewma():
+    from garage_tpu.rpc.system import System
+
+    stub = types.SimpleNamespace(clock_offsets={})
+    System._note_peer_clock(stub, b"p1", 100.0, 105.0, 100.0)
+    first = stub.clock_offsets[b"p1"]["offset"]
+    assert first == pytest.approx(5.0)
+    # a second sample at offset 15 moves the EWMA by alpha=0.3
+    System._note_peer_clock(stub, b"p1", 200.0, 215.0, 200.0)
+    assert stub.clock_offsets[b"p1"]["offset"] == pytest.approx(
+        0.3 * 15.0 + 0.7 * 5.0
+    )
+
+
+# --- timeline merge under injected skew ---------------------------------------
+
+
+def test_merge_timeline_corrects_injected_skew():
+    # node B's clock runs 10 s AHEAD: its raw timestamps are larger,
+    # but after correction its event at raw 110.5 (true 100.5) must
+    # land BETWEEN A's events at 100 and 101
+    per_node = [
+        ("aaaa", None, [{"name": "a-first", "start": 100.0},
+                        {"name": "a-second", "start": 101.0}]),
+        ("bbbb", 10.0, [{"name": "b-mid", "start": 110.5,
+                         "severity": "warn"}]),
+    ]
+    tl = merge_timeline(per_node)
+    assert [e["name"] for e in tl] == ["a-first", "b-mid", "a-second"]
+    mid = tl[1]
+    assert mid["time"] == pytest.approx(100.5)
+    assert mid["rawTime"] == pytest.approx(110.5)
+    assert mid["skewMs"] == pytest.approx(10_000.0)
+    assert mid["severity"] == "warn"
+    # without the correction the order would have been wrong
+    assert sorted(e["rawTime"] for e in tl) != [e["rawTime"] for e in tl]
+
+
+def test_merge_timeline_tolerates_garbage_events():
+    tl = merge_timeline([("n", 0.0, [{"name": "ok", "start": 1.0},
+                                     {"name": "no-start"},
+                                     {"name": "bad", "start": "zz"}])])
+    assert [e["name"] for e in tl] == ["ok"]
+
+
+# --- local event bank: severity + since filtering -----------------------------
+
+
+def test_severity_rank_order():
+    assert severity_rank("info") < severity_rank("warn") < severity_rank(
+        "critical"
+    )
+    assert severity_rank("bogus") == severity_rank("info")
+
+
+def test_record_event_severity_and_bank():
+    rec = flight.SlowRequestRecorder(threshold_ms=1e9, top_k=4)
+    flight.record_event("ev-info", {"n": 1}, recorder=rec)
+    flight.record_event("ev-warn", {"n": 2}, recorder=rec, severity="warn")
+    flight.record_event("ev-crit", {"n": 3}, recorder=rec,
+                        severity="critical")
+    flight.record_event("ev-bad", {}, recorder=rec, severity="nonsense")
+    assert [e["severity"] for e in rec.events] == [
+        "info", "warn", "critical", "info",
+    ]
+    # events land in BOTH rings; the dedicated bank is deeper than the
+    # slow-request ring so a request burst cannot evict an alert
+    assert len(rec.records) == 4
+    assert rec.events.maxlen > rec.records.maxlen
+
+    evs = local_events(rec, min_severity="warn")
+    assert [e["name"] for e in evs] == ["ev-warn", "ev-crit"]
+    # since is strict and uses the node's own clock
+    cutoff = rec.events[1]["start"]
+    evs = local_events(rec, since=cutoff)
+    assert [e["name"] for e in evs] == ["ev-crit", "ev-bad"]
+    assert local_events(None) == []
+
+
+# --- TransitionTracker against a real LayoutManager ---------------------------
+
+
+class _Reg:
+    def __init__(self):
+        self.calls = []
+
+    def incr(self, name, labels=(), by=1):
+        self.calls.append((name, tuple(labels), by))
+
+
+def _stub_garage(node_id=b"\x01" * 32, rf=1):
+    lm = LayoutManager(node_id, rf)
+    g = types.SimpleNamespace(
+        layout_manager=lm,
+        system=types.SimpleNamespace(id=node_id, clock_offsets={}),
+    )
+    return g, lm
+
+
+def _grow(lm, node_id, capacity):
+    lm.stage_role(node_id, NodeRole(zone="z1", capacity=capacity))
+    lm.apply_staged()
+
+
+def test_tracker_open_close_and_pair_accounting():
+    node = b"\x01" * 32
+    peer = b"\x02" * 32
+    g, lm = _stub_garage(node)
+    reg = _Reg()
+    rec = flight.SlowRequestRecorder(threshold_ms=1e9)
+    flight.span_fanout.attach(rec)
+    try:
+        tt = TransitionTracker(g, registry=reg)
+        assert not tt.active
+
+        _grow(lm, node, 10**12)  # v1: first real version, still single
+        assert not tt.active
+        # transfers outside a transition are steady-state, not counted
+        tt.note_transfer(peer, node, 999, partition=1)
+        assert tt.bytes_total == 0 and reg.calls == []
+
+        _grow(lm, node, 2 * 10**12)  # v2 while v1 is live: OPEN
+        assert tt.active
+        assert tt.from_version == 1 and tt.target_version == 2
+
+        tt.note_transfer(peer, node, 1000, partition=3)
+        tt.note_transfer(peer, node, 500, partition=3)
+        tt.note_transfer(node, peer, 250, partition=7)
+        assert tt.bytes_total == 1750
+        assert tt.partitions_touched == {3, 7}
+        key = (peer.hex()[:16], node.hex()[:16])
+        assert tt.pair_bytes[key] == 1500
+        assert all(c[0] == "layout_transition_pair_bytes_total"
+                   for c in reg.calls)
+        assert sum(c[2] for c in reg.calls) == 1750
+
+        ps = tt.partition_states()
+        assert ps["total"] == 256
+        assert ps["moving"] + ps["pending"] + ps["synced"] == 256
+
+        snap = tt.snapshot()
+        assert snap["inTransition"] and snap["bytesMoved"] == 1750
+        assert snap["pairs"][0] == {"src": key[0], "dst": key[1],
+                                    "bytes": 1500}
+
+        # sync v2 everywhere (single storage node): trim retires v1,
+        # the notify edge CLOSES the transition and banks the report
+        lm.mark_synced(2)
+        assert not tt.active
+        rep = tt.last_report
+        assert rep is not None and tt.reports == 1
+        assert rep["bytesMoved"] == 1750
+        assert rep["bytesMoved"] == sum(p["bytes"] for p in rep["pairs"])
+        assert rep["fromVersion"] == 1 and rep["version"] == 2
+        assert rep["partitionsTouched"] == 2
+        assert rep["canaryOk"] is True
+
+        # the transition-report flight event reached the event bank
+        evs = [e for e in rec.events if e["name"] == "transition-report"]
+        assert len(evs) == 1 and evs[0]["severity"] == "info"
+        assert evs[0]["attrs"]["bytesMoved"] == "1750"
+
+        # post-close: accounting is idle again, fraction is 1.0
+        assert tt.sync_fraction() == 1.0
+        assert tt.snapshot()["syncFraction"] == 1.0
+        assert tt.digest_fields()["act"] == 1
+    finally:
+        flight.span_fanout.detach(rec)
+
+
+def test_tracker_eta_and_throughput_sampling():
+    node = b"\x03" * 32
+    g, lm = _stub_garage(node)
+    tt = TransitionTracker(g, registry=_Reg())
+    _grow(lm, node, 10**12)
+    _grow(lm, node, 2 * 10**12)
+    assert tt.active
+
+    # drive the sampler on a fake clock; fraction comes from the real
+    # history (0.0 while nothing synced), so fake that too via sync
+    fake_now = [tt._open_mono]
+
+    tt.clock = lambda: fake_now[0]
+    fracs = iter([0.0, 0.25, 0.5])
+    tt.sync_fraction = lambda: next(fracs, 0.5)
+    tt._sample(force=True)
+    fake_now[0] += 10.0
+    tt.note_transfer(b"\x04" * 32, node, 10_000)
+    tt._sample(force=True)
+    fake_now[0] += 10.0
+    tt._sample(force=True)
+    # sync fraction grew 0.25 per 10 s → ETA to the remaining 0.5 is
+    # ~20 s (EWMA of two identical rate samples is exact)
+    assert tt.eta_secs() == pytest.approx(20.0, rel=0.05)
+    assert tt._thr_ewma is not None and tt._thr_ewma > 0
+    d = tt.digest_fields()
+    assert d["act"] == 2 and d["mvb"] == 10_000 and "eta" in d
+    assert len(tt.curve) >= 2
+
+
+def test_tracker_clock_skew_median():
+    node = b"\x05" * 32
+    g, _lm = _stub_garage(node)
+    tt = TransitionTracker(g)
+    assert tt.clock_skew_secs() is None
+    g.system.clock_offsets = {
+        b"a": {"offset": 0.010, "rtt": 0.001, "at": 0.0},
+        b"b": {"offset": 0.020, "rtt": 0.001, "at": 0.0},
+        b"c": {"offset": 9.999, "rtt": 0.001, "at": 0.0},  # one broken peer
+    }
+    # median, not mean: the broken peer must not smear the estimate
+    assert tt.clock_skew_secs() == pytest.approx(0.020)
+    assert tt.digest_fields()["sk"] == pytest.approx(20.0)
+
+
+def test_clock_skew_warn_config_validation():
+    from garage_tpu.utils.config import config_from_dict
+
+    base = {
+        "metadata_dir": "/tmp/x/meta",
+        "data_dir": "/tmp/x/data",
+        "replication_mode": "3",
+        "rpc_secret": "ab" * 32,
+    }
+    cfg = config_from_dict(base)
+    assert cfg.admin.clock_skew_warn_msec == 250.0
+    with pytest.raises(ValueError, match="clock_skew_warn_msec"):
+        config_from_dict({**base, "admin": {"clock_skew_warn_msec": 0}})
+
+
+# --- slow acceptance: 11→13 grow under live load ------------------------------
+
+
+@pytest.mark.slow
+def test_grow_11_to_13_under_load(tmp_path):
+    """ISSUE 18 acceptance: a live 11-node EC(4,2) cluster grows to 13
+    under read-after-write load.  The transition must reach sync
+    fraction 1.0 with every read green, the federated events fan-out
+    must hear all 13 nodes, and the banked transition-report's
+    bytes-moved total must equal its per-pair counters."""
+    from test_ec_cluster import make_ec_cluster, stop_cluster
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.s3.client import S3Client
+    from garage_tpu.rpc.transition import (
+        cluster_events_response,
+        transition_response,
+    )
+
+    async def main():
+        # 13 daemons in one mesh, first 11 in the initial layout
+        garages = await make_ec_cluster(
+            tmp_path, n=13, mode="ec:4:2", assign=set(range(11))
+        )
+        s3 = S3ApiServer(garages[0])
+        await s3.start("127.0.0.1", 0)
+        ep = f"http://127.0.0.1:{s3.runner.addresses[0][1]}"
+        key = await garages[0].helper.create_key("grow-test")
+        key.params().allow_create_bucket.update(True)
+        await garages[0].key_table.insert(key)
+        client = S3Client(ep, key.key_id, key.secret())
+        failures = []
+        stop = asyncio.Event()
+
+        async def load():
+            i = 0
+            bodies = {}
+            while not stop.is_set():
+                k = f"obj-{i % 24:03d}"
+                body = f"{i}:".encode() + os.urandom(20_000)
+                try:
+                    await client.put_object("grow", k, body)
+                    bodies[k] = body
+                    got = await client.get_object("grow", k)
+                    if got != bodies[k]:
+                        failures.append(f"{k}: read-after-write mismatch")
+                except Exception as e:  # noqa: BLE001 — acceptance gates
+                    failures.append(f"{k}: {e!r}")  # ...on zero failures
+                i += 1
+                await asyncio.sleep(0.02)
+
+        try:
+            await client.create_bucket("grow")
+            # seed data BEFORE the grow so the migration has bytes to move
+            seed = {}
+            for i in range(24):
+                k = f"obj-{i:03d}"
+                seed[k] = f"s{i}:".encode() + os.urandom(20_000)
+                await client.put_object("grow", k, seed[k])
+
+            loader = asyncio.create_task(load())
+            await asyncio.sleep(0.5)
+
+            # the grow: stage the two new nodes, apply on node 0
+            lm = garages[0].layout_manager
+            for i in (11, 12):
+                lm.stage_role(
+                    garages[i].node_id,
+                    NodeRole(zone=f"dc{i}", capacity=10**12),
+                )
+            lm.apply_staged()
+
+            # the transition must OPEN somewhere once gossip lands
+            for _ in range(100):
+                await asyncio.sleep(0.1)
+                if any(g.transition_tracker.active or
+                       g.transition_tracker.reports for g in garages):
+                    break
+            assert any(
+                g.transition_tracker.active or g.transition_tracker.reports
+                for g in garages
+            ), "no tracker ever saw the transition open"
+
+            # keep hammering read-after-write while the migration is live,
+            # then stop the load so the 13 single-CPU daemons can finish
+            # syncing without competing with the S3 path for the core
+            await asyncio.sleep(8.0)
+            stop.set()
+            await loader
+            assert not failures, failures[:10]
+
+            # ... and CLOSE: workers sync, trackers gossip, trim retires
+            # v1 — sync fraction 1.0 on every node.  The close is gated
+            # on every node's block-resync drain plus clean table-sync
+            # rounds; on a loaded 1-CPU box even a 7→9 grow takes ~2 min,
+            # so give 11→13 generous headroom (stall still fails loudly).
+            deadline = time.monotonic() + 420
+            while time.monotonic() < deadline:
+                await asyncio.sleep(0.5)
+                if all(not g.transition_tracker.active and
+                       g.transition_tracker.sync_fraction() == 1.0
+                       for g in garages):
+                    break
+            assert all(
+                g.transition_tracker.sync_fraction() == 1.0 for g in garages
+            ), "transition never reached sync fraction 1.0"
+
+            # flight deck: any node can report the converged cluster
+            tr = transition_response(garages[0])
+            agg = tr["cluster"]["aggregate"]
+            assert agg["nodesReporting"] >= 1
+            assert tr["local"]["syncFraction"] == 1.0
+
+            # the banked report: bytes-moved total == per-pair counters,
+            # and SOMEONE actually moved bytes for the new nodes
+            reports = [
+                g.transition_tracker.last_report
+                for g in garages
+                if g.transition_tracker.last_report is not None
+            ]
+            assert reports, "no node banked a transition-report"
+            for rep in reports:
+                assert rep["bytesMoved"] == sum(
+                    p["bytes"] for p in rep["pairs"]
+                )
+            assert sum(r["bytesMoved"] for r in reports) > 0, (
+                "no bytes were attributed to the migration"
+            )
+
+            # federated timeline: all 13 nodes answer the fan-out and
+            # the merged view carries the transition-report event
+            ev = await cluster_events_response(garages[0], since=0.0)
+            assert len(ev["nodesResponding"]) == 13, ev["nodesFailed"]
+            assert ev["nodesFailed"] == []
+            names = {e["name"] for e in ev["events"]}
+            assert "transition-report" in names
+            times = [e["time"] for e in ev["events"]]
+            assert times == sorted(times), "timeline not ordered"
+        finally:
+            stop.set()
+            await stop_cluster(garages, [s3], [client])
+
+    asyncio.run(main())
