@@ -458,6 +458,210 @@ async def run_overload(
         await stop_cluster(garages, [s3], clients)
 
 
+async def run_tenants(
+    tmp_path, n_nodes: int, duration: float, key_rate: float,
+) -> dict:
+    """Tenant-observatory mode (ISSUE 20): the BEFORE number for ROADMAP
+    item 5 (cluster-wide per-tenant budget enforcement).  Boots an
+    n-node cluster with an S3 frontend on EVERY node, three well-behaved
+    tenants in distinct SLO classes plus one abusive tenant, and a small
+    per-node admission budget (`key_rate` tokens/s per key, burst =
+    rate).  The abuser drives all n frontends flat-out; because
+    admission is per NODE, every frontend grants it a full budget — the
+    headline is its cluster-wide admitted consumption as a multiple of
+    the single-node budget (~= n until enforcement goes cluster-wide).
+    The tenant observatory must see all of it: share attribution, joined
+    sheds, per-class burn, and the fairness rollup's hog verdict."""
+    import time
+
+    from test_ec_cluster import make_ec_cluster, stop_cluster
+
+    from garage_tpu.api.s3.api_server import S3ApiServer
+    from garage_tpu.api.s3.client import S3Client, S3Error
+    from garage_tpu.rpc import tenant as tenant_mod
+    from garage_tpu.utils.config import TenantClassConfig
+
+    garages = await make_ec_cluster(
+        tmp_path, n=n_nodes, mode="ec:2:1", block_size=65536
+    )
+    servers, clients = [], []
+    try:
+        # SLO classes BEFORE any S3 traffic so every row lands in its
+        # class (config is read live; the observatory's class_resolver
+        # closes over node configs)
+        keys = {}
+        for name in ("premium", "standard", "batch", "abuser"):
+            key = await garages[0].helper.create_key(name)
+            key.params().allow_create_bucket.update(True)
+            await garages[0].key_table.insert(key)
+            keys[name] = key
+        classes = {
+            "premium": TenantClassConfig(
+                availability_target=99.99, latency_target_msec=250.0,
+                keys=[keys["premium"].key_id],
+            ),
+            "standard": TenantClassConfig(
+                availability_target=99.9, latency_target_msec=1000.0,
+                keys=[keys["standard"].key_id],
+            ),
+            # the abuser rides the cheapest class alongside a
+            # well-behaved batch tenant
+            "batch": TenantClassConfig(
+                availability_target=99.0, latency_target_msec=5000.0,
+                keys=[keys["batch"].key_id, keys["abuser"].key_id],
+            ),
+        }
+        for g in garages:
+            g.config.tenants = classes
+            # the ladder would shed whole tiers and swamp the per-key
+            # signal this mode measures; pin it calm (same pattern as
+            # --read-heavy) — the token buckets stay live
+            if g.shedder is not None:
+                g.shedder.signals = lambda consume=True: (0.0, 0.0)
+            g.overload.set_shed_tier(None)
+            # the per-bucket bucket must not be the binding constraint
+            g.config.overload.bucket_rate = 100000.0
+            g.config.overload.bucket_burst = 200000.0
+
+        # an S3 frontend on EVERY node — spreading across frontends is
+        # exactly the leak being measured
+        eps = []
+        for g in garages:
+            s3 = S3ApiServer(g)
+            await s3.start("127.0.0.1", 0)
+            servers.append(s3)
+            eps.append(f"http://127.0.0.1:{s3.runner.addresses[0][1]}")
+
+        def mk_clients(name):
+            k = keys[name]
+            cs = [S3Client(ep, k.key_id, k.secret()) for ep in eps]
+            clients.extend(cs)
+            return cs
+
+        tenants = {name: mk_clients(name) for name in keys}
+        body = os.urandom(1024)  # inline-sized: metadata-plane ops
+        for name, cs in tenants.items():
+            await cs[0].create_bucket(f"t-{name}")
+            await cs[0].put_object(f"t-{name}", "seed", body)
+
+        # setup done on the default (generous) budget; now clamp the
+        # per-key budget.  Knobs are read live and TokenBucket._refill
+        # clamps existing levels down to the new burst on first touch.
+        for g in garages:
+            g.config.overload.key_rate = key_rate
+            g.config.overload.key_burst = key_rate
+
+        snap0 = tenant_mod.observatory.snapshot(top_n=64)
+        ops0 = {t["id"]: t["ops"] for t in snap0["tenants"]}
+        stats = {
+            name: {"ok": 0, "shed": 0}
+            for name in ("premium", "standard", "batch", "abuser")
+        }
+        stop_at = time.monotonic() + duration
+
+        async def drive(name, client, pace: float | None, seq=None):
+            i = 0
+            while time.monotonic() < stop_at:
+                i += 1
+                try:
+                    if seq is None and i % 2:
+                        await client.get_object(f"t-{name}", "seed")
+                    else:
+                        await client.put_object(
+                            f"t-{name}",
+                            f"o{next(seq) if seq is not None else i:06d}",
+                            body,
+                        )
+                    stats[name]["ok"] += 1
+                except S3Error as e:
+                    if e.status == 503 and e.code == "SlowDown":
+                        stats[name]["shed"] += 1
+                        await asyncio.sleep(0.02)
+                    else:
+                        raise
+                if pace:
+                    await asyncio.sleep(pace)
+
+        import itertools
+
+        abuse_seq = itertools.count()
+        tasks = [
+            # well-behaved: paced GET/PUT mix against node0 only, well
+            # under the per-node budget
+            asyncio.create_task(drive(name, tenants[name][0], 0.25, None))
+            for name in ("premium", "standard", "batch")
+        ] + [
+            # abusive: 2 closed-loop writers against EVERY frontend
+            asyncio.create_task(
+                drive("abuser", tenants["abuser"][node], None, abuse_seq)
+            )
+            for node in range(n_nodes)
+            for _ in range(2)
+        ]
+        await asyncio.gather(*tasks)
+        await asyncio.sleep(0.05)  # trailing in-process records land
+
+        # what the observatory saw (the module singleton is shared by
+        # the in-process nodes, so its totals count each request once)
+        snap = tenant_mod.observatory.snapshot(top_n=64)
+        rows = {t["id"]: t for t in snap["tenants"]}
+
+        def obs(name):
+            r = rows.get(keys[name].key_id) or {}
+            d_ops = r.get("ops", 0) - ops0.get(keys[name].key_id, 0)
+            return {
+                "ops": d_ops,
+                "sheds": r.get("shed", 0),
+                "class": r.get("class"),
+                "burn": (r.get("burn") or {}).get("worst"),
+            }
+
+        total_run_ops = sum(
+            t["ops"] - ops0.get(t["id"], 0) for t in snap["tenants"]
+        )
+        abuse_obs = obs("abuser")
+        abuse_share = (
+            round(abuse_obs["ops"] / total_run_ops, 4)
+            if total_run_ops else None
+        )
+
+        # the fairness rollup as any node would serve it (shares and
+        # ratios are scale-invariant, so the in-process digest overlap
+        # does not distort them)
+        for _ in range(2):
+            for g in garages:
+                await g.system.status_exchange_once()
+            await asyncio.sleep(0.05)
+        resp = tenant_mod.tenants_response(garages[0])
+
+        budget = key_rate * duration + key_rate  # rate x window + burst
+        admitted = stats["abuser"]["ok"]
+        return {
+            "n_frontends": n_nodes,
+            "duration_s": duration,
+            "key_rate": key_rate,
+            "single_node_budget_ops": round(budget, 1),
+            "consumption_multiple": round(admitted / budget, 3),
+            "classes_tracked": len(classes),
+            "abusive": {
+                "admitted_ops": admitted,
+                "sheds_client": stats["abuser"]["shed"],
+                "sheds_observed": abuse_obs["sheds"],
+                "observed_share": abuse_share,
+                "class": abuse_obs["class"],
+                "burn": abuse_obs["burn"],
+            },
+            "tenants": {
+                name: {**stats[name], "observatory": obs(name)}
+                for name in stats
+            },
+            "fairness": resp["cluster"]["fairness"],
+            "hog": resp["cluster"].get("hog"),
+        }
+    finally:
+        await stop_cluster(garages, servers, clients)
+
+
 async def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--objects", type=int, default=200)
@@ -482,6 +686,20 @@ async def main() -> None:
     ap.add_argument(
         "--slo-ms", type=float, default=2500.0,
         help="overload mode: declared latency SLO for admitted traffic",
+    )
+    ap.add_argument(
+        "--tenants", action="store_true",
+        help="tenant-observatory gate (ISSUE 20): N tenants in distinct "
+        "SLO classes, one abusive, frontends on every node — banks the "
+        "abusive tenant's cluster-wide consumption multiple vs its "
+        "single-node admission budget (ROADMAP item 5 before-number)",
+    )
+    ap.add_argument("--tenant-nodes", type=int, default=3,
+                    help="tenants mode: cluster size (= S3 frontends)")
+    ap.add_argument(
+        "--key-rate", type=float, default=12.0,
+        help="tenants mode: per-key admission tokens/s on each node "
+        "(burst = rate); the single-node budget the abuser multiplies",
     )
     ap.add_argument(
         "--concurrency",
@@ -616,6 +834,34 @@ async def main() -> None:
                 # the measurement plane sees the workload it will tune
                 "observatory": ec["observatory"],
             },
+        }
+        line = json.dumps(result)
+        print(line)
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                f.write(line + "\n")
+        return
+
+    if args.tenants:
+        with tempfile.TemporaryDirectory() as d:
+            detail = await run_tenants(
+                pathlib.Path(d), args.tenant_nodes, args.duration,
+                args.key_rate,
+            )
+        mult = detail["consumption_multiple"]
+        result = {
+            "metric": "s3_tenant_cluster_consumption_multiple",
+            # > 1.0 = the abusive tenant consumed more than its intended
+            # budget by spreading across frontends (per-node admission
+            # cannot see it); ~n_frontends is the worst case.  This is
+            # the BEFORE number ROADMAP item 5's enforcement PR must
+            # push back toward 1.0.
+            "value": mult,
+            "unit": f"x single-node budget ({detail['n_frontends']} frontends)",
+            "vs_baseline": (
+                round(mult / detail["n_frontends"], 3) if mult else None
+            ),
+            "detail": detail,
         }
         line = json.dumps(result)
         print(line)

@@ -291,6 +291,15 @@ class AdminApiServer:
                 )
             )
 
+        if path == "/v1/cluster/tenants" and request.method == "GET":
+            # tenant observatory (rpc/tenant.py): cluster-summed
+            # per-tenant consumption + fairness stats + per-node rows
+            # from the gossiped tn.* digest keys — tenant KEY IDS live
+            # here (JSON), never as metric labels (cardinality guard)
+            from ...rpc.tenant import tenants_response
+
+            return web.json_response(tenants_response(g))
+
         if path == "/v1/codec" and request.method == "GET":
             # codec X-ray (ops/telemetry.py + rpc/telemetry_digest.py):
             # local per-kernel pad accounting, compile events, overlap

@@ -321,6 +321,18 @@ class AdmissionController:
             f"api_admission_{kind}_total", (("tier", TIER_NAMES[tier]),)
         )
 
+    @staticmethod
+    def _shed_tenant(key_id: str | None) -> None:
+        # join admission sheds into the tenant observatory under the
+        # CLAIMED key id — sheds happen pre-auth, so the claim is the
+        # only identity there is (rpc/tenant.py keeps it sketch-bounded)
+        try:
+            from ..rpc.tenant import observatory
+
+            observatory.record_shed(key_id)
+        except Exception:  # noqa: BLE001
+            pass  # graft-lint: allow-swallow(accounting must never turn a shed into a 500)
+
     def _release(self, exempt: bool = False) -> None:
         # queued waiters poll on _QUEUE_QUANTUM, so freeing a slot is
         # observed within ~20 ms without any notification machinery
@@ -356,6 +368,7 @@ class AdmissionController:
 
         if self._shed_from is not None and tier >= self._shed_from:
             self._count("shed", tier)
+            self._shed_tenant(key_id)
             return Ticket(
                 False, tier,
                 retry_after=max(1.0, float(cfg.shed_retry_after_secs)),
@@ -373,6 +386,7 @@ class AdmissionController:
 
         if tier != TIER_INTERACTIVE:
             self._count("shed", tier)
+            self._shed_tenant(key_id)
             reason = (
                 "request rate over the tenant budget"
                 if token_wait > 0
@@ -386,6 +400,7 @@ class AdmissionController:
         # top tier: queue-rather-than-reject, bounded in depth and time
         if self._queue_len >= int(cfg.queue_depth):
             self._count("shed", tier)
+            self._shed_tenant(key_id)
             return Ticket(
                 False, tier, retry_after=max(1.0, float(cfg.shed_retry_after_secs)),
                 reason="interactive admission queue is full",
@@ -410,6 +425,7 @@ class AdmissionController:
         finally:
             self._queue_len -= 1
         self._count("shed", tier)
+        self._shed_tenant(key_id)
         return Ticket(
             False, tier, retry_after=max(1.0, token_wait),
             reason=f"no capacity within {cfg.queue_wait_msec:g} ms queue wait",

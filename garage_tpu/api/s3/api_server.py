@@ -169,6 +169,64 @@ class S3ApiServer:
                         )
                 except Exception as e:  # noqa: BLE001
                     logger.debug("traffic record failed: %r", e)
+            try:
+                self._record_tenant(
+                    request, resp, time.perf_counter() - t0, lead_secs
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.debug("tenant record failed: %r", e)
+
+    def _record_tenant(
+        self, request, resp, secs: float, lead_secs: float
+    ) -> None:
+        """Tenant observatory feed (rpc/tenant.py): per-AUTHENTICATED-
+        key accounting, post-SigV4.  The admission controller admitted
+        on the CLAIMED key id (the only identity available pre-auth);
+        here the verified identity is known, so mismatches become the
+        `api_admission_claimed_mismatch_total` signal and only the
+        authenticated id is ever attributed usage."""
+        from ...rpc.tenant import class_for
+        from ...rpc.tenant import observatory as tenant_obs
+        from ...utils.metrics import registry
+        from ..overload import AdmissionController
+
+        if not tenant_obs.enabled:
+            return
+        # stashed by _handle right after verify_request; absent when
+        # auth never completed (failed signature, PostObject form path)
+        auth_id = request.get("tenant_key_id")
+        if not auth_id:
+            return
+        claimed = AdmissionController.claimed_key_id(request)
+        if claimed and claimed != auth_id:
+            # spoof attempts are a visible counter, never a tenant row
+            registry.incr("api_admission_claimed_mismatch_total", ())
+            tenant_obs.record_mismatch()
+        bucket_name, obj_key = self._parse_target(request)
+        if bucket_name == self.garage.config.admin.canary_bucket:
+            return  # synthetic probe traffic (same carve-out as traffic)
+        from ...rpc.traffic import classify_op
+
+        bytes_in = (
+            int(request.content_length or 0)
+            if request.method in ("PUT", "POST")
+            else 0
+        )
+        bytes_out = (
+            int(resp.content_length or 0)
+            if resp is not None and request.method in ("GET", "HEAD")
+            else 0
+        )
+        tenant_obs.record_request(
+            auth_id,
+            classify_op(request.method, obj_key, request.query),
+            bytes_in,
+            bytes_out,
+            secs,
+            is_err=resp is None or resp.status >= 500,
+            queued_secs=lead_secs,
+            tenant_class=class_for(self.garage.config, auth_id),
+        )
 
     @staticmethod
     def _moved_bytes(request, resp) -> int:
@@ -269,6 +327,10 @@ class S3ApiServer:
         with phase_span("auth"):
             ctx = await verify_request(request, self._get_secret, self.region)
             api_key: Key = await self.garage.helper.get_key(ctx.key_id)
+        # stash the AUTHENTICATED identity on the request mapping: the
+        # streaming-body proxy created below only rebinds a local, so
+        # this survives into _admitted_entry's tenant-accounting finally
+        request["tenant_key_id"] = ctx.key_id
         bucket_name, key = self._parse_target(request)
         method = request.method
 

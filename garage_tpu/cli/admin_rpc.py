@@ -673,6 +673,14 @@ class AdminRpcHandler:
             min_severity=str(args.get("min_severity") or "info"),
         )
 
+    async def op_tenants(self, args) -> Any:
+        """Tenant observatory (rpc/tenant.py): cluster-summed per-tenant
+        consumption, fairness stats, per-tenant SLO burn — `cluster
+        tenants`."""
+        from ..rpc.tenant import tenants_response
+
+        return tenants_response(self.garage)
+
     async def op_traffic(self, args) -> Any:
         """Traffic observatory (rpc/traffic.py): hot objects/buckets,
         op mix, skew, slow-peer ranking, cluster rollup — `cluster hot`."""

@@ -185,6 +185,18 @@ def main(argv=None):
         help="rebalance observatory: layout-transition flight deck, "
         "version spread, per-pair bytes moved (rpc/transition.py)",
     )
+    cten = clu_sub.add_parser(
+        "tenants",
+        help="tenant observatory: cluster-summed per-tenant consumption, "
+        "SLO burn, fairness (rpc/tenant.py)",
+    )
+    cten.add_argument(
+        "--sort", choices=["ops", "rps", "bytes", "shed", "burn"],
+        default="ops", help="cluster tenant table sort key",
+    )
+    cten.add_argument(
+        "--top", type=int, default=10, help="tenant rows to show"
+    )
     cev = clu_sub.add_parser(
         "events",
         help="federated cluster event timeline: every node's flight "
@@ -543,6 +555,23 @@ def _render_cluster_top(r: dict) -> str:
             "transition, worst skew "
             f"{'-' if skw is None else f'{skw:.0f}ms'}"
         )
+    # tenant observatory (rpc/tenant.py): cluster-wide worst tenant
+    # share vs the fair-share-multiple knob — the `cluster tenants`
+    # one-liner (the per-tenant table lives behind `cluster tenants`)
+    hog_share = agg.get("tenantHogShare")
+    hog_warn = agg.get("tenantHogShareWarn") or 3.0
+    if hog_share is not None:
+        n_ten = agg.get("tenantsSeen") or 0
+        fair = 1.0 / n_ten if n_ten else 0.0
+        line = (
+            f"tenants\t{n_ten:g} seen, worst cluster share "
+            f"{hog_share * 100:.1f}%"
+        )
+        if n_ten >= 2 and fair and hog_share > hog_warn * fair:
+            line += (
+                f" HOG! (> {hog_warn:g}x fair share {fair * 100:.1f}%)"
+            )
+        head.append(line)
     # TPU probe verdict (bench.py phased_probe, ISSUE 11): the answering
     # box's newest banked wedge profile — structured evidence, not
     # "wedged at devices" folklore
@@ -558,7 +587,7 @@ def _render_cluster_top(r: dict) -> str:
     skew_warn = agg.get("clockSkewWarnMs") or 250.0
     rows = [
         "id\thost\tup\tage\treq/s\t5xx/s\tp99\tlag99\tresyncq\tbrk\tcnry"
-        "\thot\tlayv\tflags"
+        "\thot\thog\tlayv\tflags"
     ]
     for n in r.get("nodes", []):
         d = n.get("digest") or {}
@@ -610,6 +639,16 @@ def _render_cluster_top(r: dict) -> str:
         # touching the admin API
         trf = d.get("trf") or {}
         hot = str(trf.get("hb") or "-")[:14]
+        # tenant observatory: the node's busiest-tenant ops share, with
+        # a HOG! flag when it exceeds the fair-share multiple of the
+        # node's own tracked-tenant count (the cluster-wide verdict is
+        # the head line / `cluster tenants`)
+        tn = d.get("tn") or {}
+        top1 = tn.get("top1") or 0.0
+        trk = tn.get("trk") or 0
+        hog_col = f"{float(top1) * 100:.0f}%" if top1 else "-"
+        if trk >= 2 and top1 and float(top1) > hog_warn * (1.0 / trk):
+            flags.append("HOG!")
         rows.append(
             f"{n['id'][:16]}\t{n.get('hostname', '?')}\t"
             f"{'y' if n.get('isUp') else 'n'}\t{n.get('ageSecs', 0):.0f}s\t"
@@ -617,7 +656,7 @@ def _render_cluster_top(r: dict) -> str:
             f"{_ms(s3.get('p99'))}\t{_ms((d.get('loop') or {}).get('p99'))}\t"
             f"{(d.get('resync') or {}).get('q', 0)}\t"
             f"{(d.get('rpc') or {}).get('open', 0)}\t"
-            f"{cnry}\t{hot}\t{layv}\t"
+            f"{cnry}\t{hot}\t{hog_col}\t{layv}\t"
             f"{','.join(flags) or '-'}"
         )
     out += format_table(rows)
@@ -800,6 +839,78 @@ def _render_cluster_codec(r: dict) -> str:
             f"{c.get('ovl', 0):.2f}\t{_ms(c.get('ll99'))}"
         )
     out += "\n== nodes ==\n" + format_table(rows)
+    return out
+
+
+def _render_cluster_tenants(r: dict, sort: str = "ops", top: int = 10) -> str:
+    """`cluster tenants`: the tenant observatory as an operator table —
+    fairness header, cluster-summed per-tenant consumption, then one
+    row per node from the gossiped tn.* digest keys (model: `cluster
+    durability` / `cluster codec`)."""
+    cluster = r.get("cluster") or {}
+    agg = cluster.get("aggregate") or {}
+    fair = cluster.get("fairness") or {}
+    hog = cluster.get("hog")
+    head = [
+        f"observatory\t{'enabled' if r.get('enabled') else 'DISABLED'}",
+        f"nodes\t{cluster.get('nodesReporting', 0)}/"
+        f"{len(cluster.get('nodes') or [])} reporting tenant digests",
+        f"ops\t{agg.get('ops', 0):g} cluster-wide "
+        f"({agg.get('opsPerSec', 0):g}/s), {agg.get('sheds', 0):g} shed",
+        f"identity\t{agg.get('claimedMismatches', 0):g} claimed/"
+        "authenticated key-id mismatches",
+        f"fairness\t{fair.get('tenants', 0)} tenants, top-1 share "
+        f"{(fair.get('top1Share') or 0) * 100:.1f}% "
+        f"(fair {(fair.get('fairShare') or 0) * 100:.1f}%), "
+        f"max/median {fair.get('maxMedianRatio') or '-'}, "
+        f"worst burn {fair.get('worstBurn', 0):g}",
+    ]
+    if hog:
+        head.append(
+            f"HOG!\ttenant {hog.get('id')} holds "
+            f"{(hog.get('share') or 0) * 100:.1f}% of cluster ops — "
+            f"{hog.get('multiple')}x its fair share "
+            f"(warn multiple {hog.get('warnMultiple'):g})"
+        )
+    out = format_table(head) + "\n"
+    sort_key = {
+        "ops": lambda t: t.get("ops") or 0,
+        "rps": lambda t: t.get("opsPerSec") or 0,
+        "bytes": lambda t: t.get("bytes") or 0,
+        "shed": lambda t: t.get("shed") or 0,
+        "burn": lambda t: (t.get("burn") or {}).get("worst") or 0,
+    }.get(sort) or (lambda t: t.get("ops") or 0)
+    tenants = sorted(
+        cluster.get("tenants") or [], key=sort_key, reverse=True
+    )[: max(1, top)]
+    rows = ["tenant\tclass\tops\tshare\treq/s\tbytes\tshed\tburn\tnodes"]
+    for t in tenants:
+        b = t.get("burn") or {}
+        rows.append(
+            f"{str(t.get('id'))[:20]}\t{t.get('class') or '-'}\t"
+            f"{t.get('ops', 0):g}\t{(t.get('share') or 0) * 100:.1f}%\t"
+            f"{t.get('opsPerSec', 0):g}\t{t.get('bytes', 0):g}\t"
+            f"{t.get('shed', 0):g}\t{b.get('worst', 0):g}\t"
+            f"{t.get('nodesReporting', 0)}"
+        )
+    out += "\n== tenants (cluster-summed) ==\n" + format_table(rows)
+    nrows = ["id\tup\ttracked\tops\treq/s\tshed\ttop1\twburn\tmm"]
+    for n in cluster.get("nodes") or []:
+        d = n.get("tenant")
+        if not isinstance(d, dict):
+            nrows.append(
+                f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+                "-\t-\t-\t-\t-\t-\tno-digest"
+            )
+            continue
+        nrows.append(
+            f"{n['id'][:16]}\t{'y' if n.get('isUp') else 'n'}\t"
+            f"{d.get('trk', 0):g}\t{d.get('ops', 0):g}\t"
+            f"{d.get('rps', 0):g}\t{d.get('shed', 0):g}\t"
+            f"{(d.get('top1') or 0) * 100:.0f}%\t{d.get('wburn', 0):g}\t"
+            f"{d.get('mm', 0):g}"
+        )
+    out += "\n\n== nodes ==\n" + format_table(nrows)
     return out
 
 
@@ -1096,6 +1207,11 @@ async def dispatch(args, call, config) -> str | None:
             if args.json:
                 return json.dumps(r, indent=2, default=repr)
             return _render_cluster_transition(r)
+        if args.cluster_cmd == "tenants":
+            r = await call("tenants")
+            if args.json:
+                return json.dumps(r, indent=2, default=repr)
+            return _render_cluster_tenants(r, sort=args.sort, top=args.top)
         if args.cluster_cmd == "events":
             a = {"since": args.since, "min_severity": args.min_severity}
             if not args.follow:

@@ -496,6 +496,8 @@ class Garage:
         self._latency_enabled = False
         # traffic observatory (rpc/traffic.py), enabled in start()
         self._traffic_enabled = False
+        # tenant observatory (rpc/tenant.py), enabled in start()
+        self._tenant_enabled = False
         self.canary = None
 
         # cluster telemetry plane (rpc/telemetry_digest.py): local digest
@@ -599,6 +601,21 @@ class Garage:
                 halflife=adm.traffic_halflife_secs,
             )
             self._traffic_enabled = True
+        if adm.tenant_observatory:
+            # tenant observatory (rpc/tenant.py): per-authenticated-key
+            # usage + per-class SLO burn — same refcounted-singleton
+            # discipline as the traffic observatory
+            from ..rpc import tenant
+
+            tenant.enable(topk=adm.tenant_topk)
+            # pre-auth sheds carry only a claimed key id; resolve its
+            # class against THIS node's live config for the per-class
+            # shed counter (last in-process node to start wins — the
+            # config is shared in practice)
+            tenant.observatory.class_resolver = (
+                lambda kid: tenant.class_for(self.config, kid)[0]
+            )
+            self._tenant_enabled = True
         self._register_gauges()
         # uptime measures SERVING time: restamp at start(), not object
         # construction (recovery work can run between the two)
@@ -850,6 +867,11 @@ class Garage:
 
             traffic.disable()
             self._traffic_enabled = False
+        if self._tenant_enabled:
+            from ..rpc import tenant
+
+            tenant.disable()
+            self._tenant_enabled = False
         await self.bg.shutdown()
         # after bg.shutdown(): the insert-queue workers are cancelled,
         # nothing new enters the coalescers

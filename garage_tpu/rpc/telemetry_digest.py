@@ -98,13 +98,15 @@ class DigestCollector:
     rate_window = 10.0
 
     def __init__(self, garage, registry=None, clock=time.monotonic,
-                 observatory=None):
+                 observatory=None, tenant_observatory=None):
         self.garage = garage
         self.registry = registry if registry is not None else metrics_mod.registry
         # traffic observatory (rpc/traffic.py): injectable for the same
         # reason the registry is — the production singleton is process-
         # wide, and in-process multi-node tests want per-node numbers
         self.observatory = observatory
+        # tenant observatory (rpc/tenant.py): same injection contract
+        self.tenant_observatory = tenant_observatory
         self.clock = clock
         self.started_at = clock()
         self._prev: dict[str, float] | None = None
@@ -122,6 +124,13 @@ class DigestCollector:
 
         return observatory
 
+    def _tobs(self):
+        if self.tenant_observatory is not None:
+            return self.tenant_observatory
+        from .tenant import observatory
+
+        return observatory
+
     def _counters(self) -> dict[str, float]:
         r = self.registry
         return {
@@ -132,6 +141,9 @@ class DigestCollector:
             # machinery so the gossiped trf.rps can't drift from s3.rps
             # methodology
             "trf_ops": float(self._obs().total_ops),
+            # tenant-observatory op total: same windowed-rate machinery,
+            # so the gossiped tn.rps shares the s3.rps methodology
+            "tn_ops": float(self._tobs().total_ops),
         }
 
     def collect(self) -> dict[str, Any]:
@@ -294,6 +306,11 @@ class DigestCollector:
         tt = getattr(g, "transition_tracker", None)
         if tt is not None:
             digest["lt"] = tt.digest_fields()
+        # tenant observatory (rpc/tenant.py): bounded top-N per-tenant
+        # rows + node scalars — "tn" keys are additive, DIGEST_VERSION
+        # stays 1.  Tenant key ids ride the JSON digest only; the
+        # federated exposition renders just the numeric scalars.
+        digest["tn"] = self._tobs().digest_fields(rates.get("tn_ops", 0.0))
         self._cached, self._cached_t = digest, now
         return digest
 
@@ -565,6 +582,30 @@ def _dsum(rows, *path) -> float:
     )
 
 
+def _tenant_hog_share(with_digest) -> tuple[float | None, int]:
+    """`(cluster-wide top-1 tenant ops share, distinct tenants seen)`
+    from the gossiped `tn.rows` sections (share is None until some node
+    reports a tenant).  Summing the per-node rows BEFORE taking the max
+    is the whole point: a tenant spread thin over 11 frontends looks
+    modest on every node row yet tops the cluster table — this is the
+    number the `cluster top` hog column and the HOG! flag key off."""
+    totals: dict[str, float] = {}
+    for r in with_digest:
+        trows = _dig(r, "tn", "rows")
+        if not isinstance(trows, list):
+            continue
+        for t in trows:
+            if not isinstance(t, dict) or not isinstance(t.get("id"), str):
+                continue
+            totals[t["id"]] = totals.get(t["id"], 0.0) + (
+                _num(t.get("ops"), 0.0) or 0.0
+            )
+    total = sum(totals.values())
+    if not totals or total <= 0:
+        return None, len(totals)
+    return max(totals.values()) / total, len(totals)
+
+
 def _cluster_slo(garage, with_digest) -> dict[str, Any] | None:
     """Request-weighted cluster SLO across every reporting node's
     window — shared by rollup() and the federated exposition (which must
@@ -625,6 +666,7 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
         return min(vals) if vals else None
 
     slo = _cluster_slo(garage, with_digest)
+    hog_share, tenants_seen = _tenant_hog_share(with_digest)
     h = garage.system.health(outlier_nodes=sorted(outliers))
     return {
         "node": garage.node_id.hex(),
@@ -695,6 +737,13 @@ def rollup(garage, rows=None, outliers=None) -> dict[str, Any]:
                 default=None,
             ),
             "clockSkewWarnMs": garage.config.admin.clock_skew_warn_msec,
+            # tenant observatory: worst cluster-wide tenant ops share
+            # (per-node tn.rows summed by tenant id first), distinct
+            # tenants seen (fair share = 1/tenantsSeen), and the
+            # fair-share-multiple knob the HOG! flag compares against
+            "tenantHogShare": hog_share,
+            "tenantsSeen": tenants_seen,
+            "tenantHogShareWarn": garage.config.admin.tenant_hog_share,
         },
         "outliers": outliers,
         "slo": slo,
@@ -930,6 +979,27 @@ _CLUSTER_FAMILIES: list[tuple[str, str, Any]] = [
      "median NTP-style wall-clock offset vs peers (positive = peers "
      "ahead); the merged event timeline's ordering error bound",
      ("lt", "sk")),
+    # tenant observatory (rpc/tenant.py): numeric tn digest scalars
+    # only — tenant key ids stay in /v1/cluster/tenants JSON, never a
+    # label (the PR 12 cardinality rule)
+    ("cluster_node_tenant_tracked",
+     "distinct tenant keys the node's sketch currently tracks",
+     ("tn", "trk")),
+    ("cluster_node_tenant_ops_total",
+     "cumulative tenant-attributed S3 ops", ("tn", "ops")),
+    ("cluster_node_tenant_ops_per_second",
+     "tenant-attributed op rate", ("tn", "rps")),
+    ("cluster_node_tenant_sheds_total",
+     "cumulative admission sheds joined to a claimed tenant",
+     ("tn", "shed")),
+    ("cluster_node_tenant_top1_share",
+     "ops share of the node's busiest tenant", ("tn", "top1")),
+    ("cluster_node_tenant_worst_burn",
+     "worst per-tenant SLO burn rate on the node (availability or "
+     "latency dimension)", ("tn", "wburn")),
+    ("cluster_node_tenant_claimed_mismatches_total",
+     "requests whose pre-auth claimed key id disagreed with the "
+     "SigV4-authenticated id", ("tn", "mm")),
 ]
 
 

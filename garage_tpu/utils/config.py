@@ -108,6 +108,34 @@ class AdminConfig:
     # the merged event timeline's ordering is not trustworthy at
     # sub-threshold granularity
     clock_skew_warn_msec: float = 250.0
+    # tenant observatory (rpc/tenant.py): per-authenticated-key usage
+    # accounting + per-class SLO burn, gossiped as the `tn.*` digest
+    # section and federated via /v1/cluster/tenants — on by default,
+    # bounded memory (Space-Saving top-K over tenant ids gates exact
+    # rows)
+    tenant_observatory: bool = True
+    tenant_topk: int = 64
+    # HOG! threshold: a tenant whose cluster-wide consumption share
+    # exceeds this multiple of the fair share (1/tenants) flags in
+    # `cluster top` and emits the `tenant-hog` flight event
+    tenant_hog_share: float = 3.0
+
+
+@dataclass
+class TenantClassConfig:
+    """Rebuild-specific: one `[tenants.<class>]` SLO class for the
+    tenant observatory (rpc/tenant.py).  A class names its availability
+    and latency targets and lists the access-key ids that belong to it;
+    keys not listed anywhere fall to the `default` class (which may
+    itself be configured here to override the built-in targets)."""
+
+    # percent of the tenant's requests answered without a 5xx
+    availability_target: float = 99.9
+    # per-request latency target: requests over it burn the tenant's
+    # latency budget (same allowed fraction as availability)
+    latency_target_msec: float = 1000.0
+    # access-key ids (the AUTHENTICATED identity) in this class
+    keys: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -352,6 +380,9 @@ class Config:
     overload: OverloadConfig = field(default_factory=OverloadConfig)
     consul_discovery: ConsulDiscoveryConfig | None = None
     kubernetes_discovery: KubernetesDiscoveryConfig | None = None
+    # `[tenants.<class>]` SLO classes for the tenant observatory
+    # (rpc/tenant.py): class name -> targets + member key ids
+    tenants: dict[str, TenantClassConfig] = field(default_factory=dict)
 
     # --- derived -----------------------------------------------------------
 
@@ -579,6 +610,16 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
             cfg.kubernetes_discovery = KubernetesDiscoveryConfig(
                 **_known(v, KubernetesDiscoveryConfig)
             )
+        elif k == "tenants":
+            if not isinstance(v, dict):
+                raise ValueError(
+                    "[tenants] must be a table of [tenants.<class>] "
+                    "sections"
+                )
+            cfg.tenants = {
+                str(name): TenantClassConfig(**_known(tc, TenantClassConfig))
+                for name, tc in v.items()
+            }
         # unknown sections are ignored (forward compat)
     # metadata_fsync is tri-state, not stringly-typed: anything else (a
     # "goup" typo, "yes", 2) used to fall through as a truthy value and
@@ -619,6 +660,47 @@ def config_from_dict(raw: dict[str, Any]) -> Config:
     # every node SKEW! on the first status exchange
     if float(cfg.admin.clock_skew_warn_msec) <= 0:
         raise ValueError("clock_skew_warn_msec must be > 0")
+    # tenant observatory: a tiny top-K can't rank anything; a hog
+    # threshold below 1 would flag tenants consuming LESS than their
+    # fair share
+    if int(cfg.admin.tenant_topk) < 8:
+        raise ValueError("tenant_topk must be >= 8")
+    if float(cfg.admin.tenant_hog_share) < 1:
+        raise ValueError("tenant_hog_share must be >= 1")
+    # `[tenants.<class>]` SLO classes: same footguns as the global slo_*
+    # knobs — a 100% availability target makes the allowed-error
+    # fraction zero, and a key id claimed by two classes would make
+    # per-tenant burn depend on dict iteration order
+    seen_keys: dict[str, str] = {}
+    for name, tc in cfg.tenants.items():
+        if not str(name).strip():
+            raise ValueError("[tenants] class names must be non-empty")
+        # class names become a metric LABEL value (api_tenant_class_*):
+        # the shape contract enrolled in BOUNDED_LABEL_VALUES
+        # (script/dashboard_lint.py) is enforced here, at config load
+        if not re.fullmatch(r"[a-zA-Z0-9][a-zA-Z0-9_.\-]{0,63}", str(name)):
+            raise ValueError(
+                f"invalid tenants class name {name!r}: want "
+                "[a-zA-Z0-9][a-zA-Z0-9_.-]{0,63}"
+            )
+        if not (0.0 < float(tc.availability_target) < 100.0):
+            raise ValueError(
+                f"invalid tenants.{name}.availability_target "
+                f"{tc.availability_target!r}: want a percentage in "
+                "(0, 100), e.g. 99.9"
+            )
+        if float(tc.latency_target_msec) <= 0:
+            raise ValueError(
+                f"tenants.{name}.latency_target_msec must be > 0"
+            )
+        for kid in tc.keys or []:
+            other = seen_keys.get(kid)
+            if other is not None:
+                raise ValueError(
+                    f"key {kid!r} listed in both tenant classes "
+                    f"{other!r} and {name!r}"
+                )
+            seen_keys[kid] = str(name)
     # durability observatory knobs: a zero batch can never finish a
     # pass, a non-positive interval busy-loops full rc-tree walks
     du = cfg.durability

@@ -172,6 +172,33 @@ FLOORS: dict[str, list[tuple[str, str, float, str]]] = {
         ("events_nodes_failed", "<=", 0,
          "federated event fan-out heard every node"),
     ],
+    "BENCH_tenants.json": [
+        # tenant observatory (ISSUE 20): the committed BEFORE number for
+        # ROADMAP item 5 — per-node admission hands an abusive tenant a
+        # full budget on EVERY frontend, so its cluster-wide consumption
+        # is a >1x multiple of the single-node budget (~n_frontends
+        # until enforcement goes cluster-wide).  The enforcement PR is
+        # expected to push `value` toward 1.0 and flip this gate into a
+        # ceiling; until then the floors prove the leak is measured and
+        # the observatory saw all of it.  (`>=` floors double as
+        # presence checks — a deleted/reshaped artifact fails loudly.)
+        ("value", ">=", 1.3,
+         "abusive tenant exceeds its single-node budget cluster-wide"),
+        ("detail.n_frontends", ">=", 2,
+         "the leak needs more than one frontend to exist"),
+        ("detail.single_node_budget_ops", ">=", 1,
+         "per-node admission budget banked"),
+        ("detail.abusive.admitted_ops", ">=", 10,
+         "abusive workload actually ran"),
+        ("detail.abusive.sheds_observed", ">=", 1,
+         "admission sheds joined into the tenant rows end-to-end"),
+        ("detail.abusive.observed_share", ">=", 0.4,
+         "observatory attributes the dominant share to the abuser"),
+        ("detail.classes_tracked", ">=", 2,
+         "distinct SLO classes configured for the run"),
+        ("detail.fairness.top1Share", ">=", 0.4,
+         "fairness rollup sees the skewed share on the cluster surface"),
+    ],
     "BENCH_s3_overload.json": [
         # overload-control plane (ISSUE 8): 4x burst on 11-node EC(8,3)
         # — measured 0.575 (admitted p99 1437 ms vs the 2500 ms SLO),

@@ -61,7 +61,7 @@ HIST_SUFFIXES = ("_bucket", "_count", "_sum")
 # `src`/`dst` carry node-id prefixes (bounded by cluster size, not object
 # count) and `severity` a three-value enum — guarded so a new family
 # cannot adopt them without declaring its bound below.
-GUARDED_LABELS = ("key", "bucket", "src", "dst", "severity")
+GUARDED_LABELS = ("key", "bucket", "src", "dst", "severity", "class")
 
 # codec X-ray label sets (ISSUE 17): every kernel name a dispatch site
 # passes and every compile-accounting cache label.  The compile family's
@@ -83,6 +83,13 @@ _COMPILE_CACHES = frozenset({
 # frozenset.  lint_exposition accepts either form.
 _HEX16 = re.compile(r"[0-9a-f]{1,16}")
 _EVENT_SEVERITIES = frozenset({"info", "warn", "critical"})
+# durability ledger classes (block/durability.py DUR_CLASSES)
+_DUR_CLASSES = frozenset({"healthy", "degraded", "at_risk", "unreadable"})
+# tenant SLO classes (ISSUE 20): operator-declared `[tenants]` section
+# names — bounded by config, not by live tenants, so the contract is a
+# shape regex (utils/config.py validation rejects empty names; tenant
+# KEY IDS never become labels at all)
+_TENANT_CLASS = re.compile(r"[a-zA-Z0-9][a-zA-Z0-9_.\-]{0,63}")
 BOUNDED_LABEL_VALUES: dict[str, dict[str, object]] = {
     # A family listed here has EVERY listed label enforced against its
     # declared value set by lint_exposition (not just GUARDED_LABELS):
@@ -101,6 +108,13 @@ BOUNDED_LABEL_VALUES: dict[str, dict[str, object]] = {
     },
     "layout_transition_pair_bytes_total": {"src": _HEX16, "dst": _HEX16},
     "flight_events_total": {"severity": _EVENT_SEVERITIES},
+    "durability_blocks": {"class": _DUR_CLASSES},
+    # tenant observatory (ISSUE 20): per-CLASS counters only — per-key
+    # accounting lives in /v1/cluster/tenants JSON
+    "api_tenant_class_requests_total": {"class": _TENANT_CLASS},
+    "api_tenant_class_errors_total": {"class": _TENANT_CLASS},
+    "api_tenant_class_over_latency_total": {"class": _TENANT_CLASS},
+    "api_tenant_class_sheds_total": {"class": _TENANT_CLASS},
 }
 
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
